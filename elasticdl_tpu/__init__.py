@@ -1,0 +1,15 @@
+"""ElasticDL-TPU: an elastic, TPU-native deep-learning framework.
+
+A ground-up JAX/XLA re-design of the ElasticDL elastic parameter-server
+architecture (reference: sorrycc/elasticdl). One *master* process acts as
+job controller, dynamic data sharder, and parameter server; stateless
+*workers* pull (task, model) pairs, run `jax.value_and_grad` on TPU
+devices (locally data-parallel over an ICI mesh via `shard_map`), and
+push pre-reduced gradients back over gRPC. Fault tolerance comes from
+dynamic data sharding + task recovery, not checkpoints.
+
+Reference architecture map: /root/reference/elasticdl/python/master/servicer.py:21-59
+(master-as-PS), /root/reference/elasticdl/python/worker/worker.py:23-463 (worker loop).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,38 @@
+"""Shared constants (reference: elasticdl/python/common/constants.py:1-35)."""
+
+# gRPC message caps: full models ride single messages on the PS path
+# (reference caps at 256 MiB, constants.py:1-5; we allow 1 GiB because
+# ResNet-50-scale bf16 payloads plus headroom fit comfortably and XLA
+# hosts have the memory).
+GRPC_MAX_MESSAGE_LENGTH = 1024 * 1024 * 1024
+
+GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_LENGTH),
+    ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_LENGTH),
+]
+
+SERVICE_NAME = "elasticdl_tpu.Master"
+
+
+class WorkerManagerStatus(object):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    FINISHED = "Finished"
+
+
+class JobType(object):
+    TRAINING_ONLY = "training"
+    EVALUATION_ONLY = "evaluation"
+    PREDICTION_ONLY = "prediction"
+    TRAINING_WITH_EVALUATION = "training_with_evaluation"
+
+
+class Mode(object):
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+
+
+# Worker gives up on a minibatch after this many stale-gradient retries
+# (reference: elasticdl/python/worker/worker.py:20).
+MAX_MINIBATCH_RETRY_NUM = 64

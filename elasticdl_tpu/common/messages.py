@@ -1,0 +1,81 @@
+"""Wire messages for the master<->worker protocol.
+
+Replaces the reference's protobuf contract
+(elasticdl/proto/elasticdl.proto:7-120) with msgpack-serialized
+dataclasses over the dtype-aware codec. The RPC surface is preserved:
+GetTask, GetModel, ReportVariable, ReportGradient,
+ReportEvaluationMetrics, ReportTaskResult (elasticdl.proto:113-120) —
+plus the embedding-store RPCs that replace the reference's external
+Redis side channel (elasticdl/python/master/embedding_service.py:270-357).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from elasticdl_tpu.common import codec
+
+
+class TaskType(object):
+    """reference: elasticdl/proto/elasticdl.proto:7-12"""
+
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+    WAIT = "wait"
+
+
+class MethodType(object):
+    """Model-pull semantics (reference: elasticdl.proto:14-17).
+
+    MINIMUM: any model with version >= requested. FIXED: exactly the
+    requested version (served from a pinned evaluation snapshot).
+    """
+
+    MINIMUM = "minimum"
+    FIXED = "fixed"
+
+
+@dataclasses.dataclass
+class Task:
+    """A dynamic data shard: records [start, end) of one file
+    (reference: elasticdl.proto:22-41)."""
+
+    task_id: int = -1
+    shard_file_name: str = ""
+    start: int = 0
+    end: int = 0
+    type: str = TaskType.WAIT
+    model_version: int = -1
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Task":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class Model:
+    """Versioned parameter pytree (reference: elasticdl.proto:57-60,
+    generalized from a flat name->Tensor map to a nested pytree)."""
+
+    version: int = 0
+    params: Any = None  # pytree of np.ndarray
+
+    def to_wire(self) -> dict:
+        return {"version": self.version, "params": self.params}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Model":
+        return cls(version=d["version"], params=d["params"])
+
+
+def pack(obj: Any) -> bytes:
+    return codec.dumps(obj)
+
+
+def unpack(data: bytes) -> Any:
+    return codec.loads(data)

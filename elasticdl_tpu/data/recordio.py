@@ -1,0 +1,200 @@
+"""RecordIO: record-granular sharded files with range reads.
+
+Replaces the reference's external `pyrecordio` dependency
+(elasticdl/python/common/dataset.py:7-33; record counting at
+master/main.py:48-50; range scanning at worker/task_data_service.py:126-135)
+with an in-tree format:
+
+    [u32 LE payload_len][u32 crc32(payload)][payload] ...
+
+Reads are zero-copy: the file is mmapped and records are sliced as
+memoryviews. The O(file) index build is done by the native C++ library
+(data/recordio_cpp/recordio.cc) loaded over ctypes, with a pure-Python
+fallback when the toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import struct
+import subprocess
+import threading
+import zlib
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+_HEADER = struct.Struct("<II")
+
+_native_lock = threading.Lock()
+_native_lib: Optional[ctypes.CDLL] = None
+_native_tried = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the C++ indexer; None on failure."""
+    global _native_lib, _native_tried
+    with _native_lock:
+        if _native_tried:
+            return _native_lib
+        _native_tried = True
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, "recordio_cpp", "recordio.cc")
+        so = os.path.join(here, "_native", "libedlrio.so")
+        try:
+            if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+                os.makedirs(os.path.dirname(so), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", so],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(so)
+            lib.edlrio_count.restype = ctypes.c_int64
+            lib.edlrio_count.argtypes = [ctypes.c_char_p]
+            lib.edlrio_index.restype = ctypes.c_int64
+            lib.edlrio_index.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+            ]
+            lib.edlrio_verify.restype = ctypes.c_int64
+            lib.edlrio_verify.argtypes = [ctypes.c_char_p]
+            _native_lib = lib
+        except Exception as e:  # pragma: no cover - toolchain missing
+            logger.warning("native recordio unavailable (%s); using Python path", e)
+            _native_lib = None
+        return _native_lib
+
+
+class RecordIOWriter:
+    """Sequential record writer (offline data prep; the hot path is reads)."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "wb")
+
+    def write(self, payload: bytes):
+        if not isinstance(payload, (bytes, bytearray, memoryview)):
+            raise TypeError("record payload must be bytes")
+        payload = bytes(payload)
+        self._f.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _python_index(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    offsets: List[int] = []
+    sizes: List[int] = []
+    filesize = os.path.getsize(path)
+    with open(path, "rb") as f:
+        pos = 0
+        while pos + _HEADER.size <= filesize:
+            length, _crc = _HEADER.unpack(f.read(_HEADER.size))
+            offsets.append(pos + _HEADER.size)
+            sizes.append(length)
+            pos += _HEADER.size + length
+            f.seek(pos)
+    return np.asarray(offsets, dtype=np.int64), np.asarray(sizes, dtype=np.int64)
+
+
+def build_index(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(offsets, sizes) int64 arrays — native when available."""
+    lib = _load_native()
+    if lib is None:
+        return _python_index(path)
+    n = lib.edlrio_count(path.encode())
+    if n < 0:
+        raise IOError(f"corrupt or unreadable recordio file: {path}")
+    offsets = np.zeros(n, dtype=np.int64)
+    sizes = np.zeros(n, dtype=np.int64)
+    if n:
+        got = lib.edlrio_index(
+            path.encode(),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+        )
+        if got != n:
+            raise IOError(f"recordio index changed underfoot: {path}")
+    return offsets, sizes
+
+
+def count_records(path: str) -> int:
+    """Record count (reference: recordio.Index use at master/main.py:48-50)."""
+    lib = _load_native()
+    if lib is not None:
+        n = lib.edlrio_count(path.encode())
+        if n < 0:
+            raise IOError(f"corrupt or unreadable recordio file: {path}")
+        return int(n)
+    return len(_python_index(path)[0])
+
+
+def verify(path: str) -> bool:
+    """CRC-check every record (native)."""
+    lib = _load_native()
+    if lib is not None:
+        return lib.edlrio_verify(path.encode()) == 0
+    offsets, sizes = _python_index(path)
+    with open(path, "rb") as f:
+        data = f.read()
+    for off, size in zip(offsets.tolist(), sizes.tolist()):
+        crc = _HEADER.unpack_from(data, off - _HEADER.size)[1]
+        if zlib.crc32(data[off : off + size]) != crc:
+            return False
+    return True
+
+
+class RecordIOReader:
+    """Zero-copy range reader (reference: recordio.Scanner semantics at
+    worker/task_data_service.py:126-135 — yield records [start, end))."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._offsets, self._sizes = build_index(path)
+        self._f = open(path, "rb")
+        self._mm = (
+            mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+            if os.path.getsize(path)
+            else None
+        )
+
+    def __len__(self) -> int:
+        return len(self._offsets)
+
+    def read(self, idx: int) -> bytes:
+        off = int(self._offsets[idx])
+        size = int(self._sizes[idx])
+        return self._mm[off : off + size]
+
+    def read_range(self, start: int, end: int) -> Iterator[bytes]:
+        end = min(end, len(self))
+        for i in range(start, end):
+            yield self.read(i)
+
+    def close(self):
+        if self._mm is not None:
+            self._mm.close()
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

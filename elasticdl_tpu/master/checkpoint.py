@@ -1,0 +1,133 @@
+"""Checkpoint service: versioned model snapshots with rotation.
+
+Reference: elasticdl/python/master/checkpoint_service.py:16-108.
+Checkpoints are *optional output*, not the recovery mechanism —
+fault-tolerance is dynamic sharding (README.md:10-12). Two stores:
+
+- durable checkpoints every `checkpoint_steps` versions, ring-buffer
+  rotated to `keep_checkpoint_max` files (`model_v{N}.ckpt`);
+- ephemeral **evaluation snapshots**: a fixed-version model pinned for
+  consistent evaluation, deleted when the eval job completes
+  (checkpoint_service.py:43-45, 74-78).
+
+Files are the wire codec's serialized form, so a checkpoint can also be
+served directly over GetModel(FIXED). Unlike the reference, the
+embedding store can be included (closing the acknowledged gap at
+doc/distributed_embedding_layer_design.md:425-428).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+from elasticdl_tpu.common import codec
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.common.messages import Model
+
+logger = get_logger(__name__)
+
+
+def save_model_file(
+    path: str, params: Any, version: int, embeddings: Optional[Dict] = None
+):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {"version": version, "params": params}
+    if embeddings is not None:
+        payload["embeddings"] = embeddings
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(codec.dumps(payload))
+    os.replace(tmp, path)
+
+
+def load_model_file(path: str) -> Model:
+    with open(path, "rb") as f:
+        d = codec.loads(f.read())
+    m = Model(version=d["version"], params=d["params"])
+    m.embeddings = d.get("embeddings")  # type: ignore[attr-defined]
+    return m
+
+
+class CheckpointService:
+    def __init__(
+        self,
+        checkpoint_dir: str = "",
+        checkpoint_steps: int = 0,
+        keep_checkpoint_max: int = 0,
+        include_evaluation: bool = False,
+        embedding_store=None,
+    ):
+        self._directory = checkpoint_dir
+        self._steps = checkpoint_steps
+        self._max_versions = keep_checkpoint_max
+        self._embedding_store = embedding_store
+        if not self._directory:
+            self._directory = tempfile.mkdtemp(prefix="edl_tpu_ckpt_")
+        os.makedirs(self._directory, exist_ok=True)
+        self._checkpoint_list: list[str] = []
+        self._eval_checkpoint_dir = ""
+        self._eval_models: Dict[int, str] = {}
+        if include_evaluation:
+            self._eval_checkpoint_dir = tempfile.mkdtemp(prefix="edl_tpu_evalckpt_")
+
+    def is_enabled(self) -> bool:
+        return bool(self._steps)
+
+    def need_to_checkpoint(self, version: int) -> bool:
+        """reference: checkpoint_service.py:59-61."""
+        return self.is_enabled() and version % self._steps == 0
+
+    def _path(self, version: int, is_eval: bool) -> str:
+        d = self._eval_checkpoint_dir if is_eval else self._directory
+        return os.path.join(d, f"model_v{version}.ckpt")
+
+    def save(self, params: Any, version: int, is_eval: bool = False):
+        """reference: checkpoint_service.py:47-72 (rotation included)."""
+        path = self._path(version, is_eval)
+        emb = None
+        if not is_eval and self._embedding_store is not None:
+            emb = self._embedding_store.snapshot()
+        save_model_file(path, params, version, embeddings=emb)
+        if is_eval:
+            self._eval_models[version] = path
+        else:
+            logger.info("Checkpoint saved: %s", path)
+            self._checkpoint_list.append(path)
+            if self._max_versions:
+                while len(self._checkpoint_list) > self._max_versions:
+                    stale = self._checkpoint_list.pop(0)
+                    try:
+                        os.remove(stale)
+                    except FileNotFoundError:
+                        pass
+
+    # -- evaluation snapshots (FIXED model pulls) ----------------------------
+
+    def get_eval_model(self, version: int) -> Optional[Model]:
+        path = self._eval_models.get(version)
+        if path is None or not os.path.exists(path):
+            return None
+        return load_model_file(path)
+
+    def remove_eval_checkpoint(self, version: int):
+        """reference: evaluation_service.py:184-208 deletes the pinned
+        snapshot when the eval job completes."""
+        path = self._eval_models.pop(version, None)
+        if path:
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+
+    # -- lookup by version (reference: checkpoint_service.py:80-108) ---------
+
+    def load_version(self, version: int) -> Optional[Model]:
+        path = self._path(version, is_eval=False)
+        if not os.path.exists(path):
+            return None
+        return load_model_file(path)
+
+    def latest_path(self) -> Optional[str]:
+        return self._checkpoint_list[-1] if self._checkpoint_list else None

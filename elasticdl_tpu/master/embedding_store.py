@@ -1,0 +1,116 @@
+"""PS-resident sparse embedding store.
+
+Replaces the reference's external 6-node Redis Cluster
+(elasticdl/python/master/embedding_service.py:82-357) with an in-master
+sharded hash store. The API surface is preserved:
+
+- `lookup(layer, ids)` -> (values, unknown_indices)  — mirrors
+  `EmbeddingService.lookup_embedding` (:270-313);
+- `update(layer, ids, values, set_if_not_exist)` — mirrors
+  `update_embedding`'s pipelined SET / SETNX (:315-357); SETNX gives
+  lazy, race-free initialization of unseen ids by concurrent workers
+  (doc/distributed_embedding_layer_design.md:278-307).
+
+Rows are keyed `(layer, id)` exactly like the reference's `layer-id`
+string keys (layers/embedding.py:85-87). Optimizer slot rows live in
+the same store under slot-qualified layer names (`layer/slot`),
+mirroring `layer-slot-id` keys (optimizer_wrapper.py:231-290).
+
+Sharded locking: ids hash onto N independent shards so concurrent
+worker lookups don't serialize — the moral equivalent of the Redis
+cluster's 6-way slot sharding.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_NUM_SHARDS = 8
+
+
+class EmbeddingStore:
+    def __init__(self):
+        self._shards: List[Dict[Tuple[str, int], np.ndarray]] = [
+            {} for _ in range(_NUM_SHARDS)
+        ]
+        self._locks = [threading.Lock() for _ in range(_NUM_SHARDS)]
+
+    @staticmethod
+    def _shard_of(key: Tuple[str, int]) -> int:
+        return hash(key) % _NUM_SHARDS
+
+    def lookup(
+        self, layer: str, ids: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Batch fetch; returns (values [n, dim], unknown_index [k]).
+
+        Unknown rows are zero-filled in `values`; their positions are
+        listed in `unknown_index` so the caller can initialize them
+        (reference: embedding_service.py:270-313 returns the same pair).
+        """
+        rows: List[Optional[np.ndarray]] = []
+        unknown = []
+        for pos, raw_id in enumerate(np.asarray(ids).tolist()):
+            key = (layer, int(raw_id))
+            s = self._shard_of(key)
+            with self._locks[s]:
+                row = self._shards[s].get(key)
+            if row is None:
+                unknown.append(pos)
+            rows.append(row)
+        dim = next((r.shape[0] for r in rows if r is not None), None)
+        if dim is None:
+            return np.zeros((len(rows), 0), dtype=np.float32), np.asarray(
+                unknown, dtype=np.int64
+            )
+        out = np.zeros((len(rows), dim), dtype=np.float32)
+        for i, r in enumerate(rows):
+            if r is not None:
+                out[i] = r
+        return out, np.asarray(unknown, dtype=np.int64)
+
+    def update(
+        self,
+        layer: str,
+        ids: np.ndarray,
+        values: np.ndarray,
+        set_if_not_exist: bool = False,
+    ):
+        """Batch write; with `set_if_not_exist` only absent keys are
+        written (SETNX semantics, reference: embedding_service.py:315-357)."""
+        values = np.asarray(values, dtype=np.float32)
+        for raw_id, row in zip(np.asarray(ids).tolist(), values):
+            key = (layer, int(raw_id))
+            s = self._shard_of(key)
+            with self._locks[s]:
+                if set_if_not_exist and key in self._shards[s]:
+                    continue
+                self._shards[s][key] = np.array(row, dtype=np.float32)
+
+    # -- introspection / checkpointing --------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[int, np.ndarray]]:
+        """Full table dump {layer: {id: row}} — used by checkpointing.
+        (The reference *cannot* checkpoint its Redis tables — an
+        acknowledged gap, doc/distributed_embedding_layer_design.md:425-428;
+        we close it.)"""
+        out: Dict[str, Dict[int, np.ndarray]] = {}
+        for s, lock in zip(self._shards, self._locks):
+            with lock:
+                for (layer, raw_id), row in s.items():
+                    out.setdefault(layer, {})[raw_id] = row.copy()
+        return out
+
+    def restore(self, snap: Dict[str, Dict[int, np.ndarray]]):
+        for layer, rows in snap.items():
+            for raw_id, row in rows.items():
+                key = (layer, int(raw_id))
+                s = self._shard_of(key)
+                with self._locks[s]:
+                    self._shards[s][key] = np.asarray(row, dtype=np.float32)
+
+    def __len__(self):
+        return sum(len(s) for s in self._shards)

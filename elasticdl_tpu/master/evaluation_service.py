@@ -1,0 +1,173 @@
+"""Evaluation-during-training service.
+
+Reference: elasticdl/python/master/evaluation_service.py:12-208.
+
+- `_EvaluationJob` accumulates per-metric weighted sums over worker
+  minibatch reports and averages at completion (:12-52);
+- step-based triggering every `eval_steps` model versions (:165-173)
+  and time-based triggering on a daemon thread after `start_delay_secs`
+  with `throttle_secs` spacing (:55-87);
+- each eval pins the current model version via an evaluation snapshot
+  and creates EVALUATION tasks bound to it (:131-163);
+- on completion, metrics go to the metrics writer (TensorBoard in the
+  reference) and the snapshot is deleted (:184-208).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+
+class _EvaluationJob:
+    """reference: evaluation_service.py:12-52."""
+
+    def __init__(self, model_version: int, total_tasks: int = -1):
+        self.model_version = model_version
+        self._total_tasks = total_tasks
+        self._completed_tasks = 0
+        self._metric_sums: Dict[str, float] = {}
+        self._num_examples = 0
+
+    def complete_task(self):
+        self._completed_tasks += 1
+
+    def finished(self) -> bool:
+        return self._completed_tasks >= self._total_tasks
+
+    def report_metrics(self, metrics: Dict[str, float], num_examples: int):
+        for name, value in metrics.items():
+            self._metric_sums[name] = (
+                self._metric_sums.get(name, 0.0) + float(value) * num_examples
+            )
+        self._num_examples += num_examples
+
+    def get_metrics(self) -> Dict[str, float]:
+        if not self._num_examples:
+            return {}
+        return {k: v / self._num_examples for k, v in self._metric_sums.items()}
+
+
+class _EvaluationTrigger(threading.Thread):
+    """Time-based eval trigger daemon (reference: :55-87)."""
+
+    def __init__(self, eval_service, start_delay_secs: float, throttle_secs: float):
+        super().__init__(daemon=True)
+        self._service = eval_service
+        self._start_delay = start_delay_secs
+        self._throttle = throttle_secs
+        self._stopper = threading.Event()
+
+    def stop(self):
+        self._stopper.set()
+
+    def _wait_enough_time(self, cur: float, previous: float) -> bool:
+        return cur - previous >= self._throttle
+
+    def run(self):
+        start_time = time.time()
+        previous = float("-inf")
+        while not self._stopper.is_set():
+            now = time.time()
+            if now - start_time > self._start_delay and self._wait_enough_time(
+                now, previous
+            ):
+                self._service.add_evaluation_task()
+                previous = now
+            time.sleep(1)
+
+
+class EvaluationService:
+    def __init__(
+        self,
+        checkpoint_service,
+        task_dispatcher,
+        start_delay_secs: float = 0,
+        throttle_secs: float = 0,
+        eval_steps: int = 0,
+        time_based: bool = False,
+        current_model_fn: Optional[Callable] = None,
+        metrics_writer: Optional[Callable[[int, Dict[str, float]], None]] = None,
+    ):
+        self._checkpoint_service = checkpoint_service
+        self._task_d = task_dispatcher
+        self._eval_steps = eval_steps
+        self._current_model_fn = current_model_fn  # () -> (params, version)
+        self._metrics_writer = metrics_writer
+        self._lock = threading.Lock()
+        self._eval_job: Optional[_EvaluationJob] = None
+        self._last_eval_version = -1
+        self.completed_metrics: list[tuple[int, Dict[str, float]]] = []
+        self._trigger: Optional[_EvaluationTrigger] = None
+        if time_based:
+            self._trigger = _EvaluationTrigger(self, start_delay_secs, throttle_secs)
+            self._trigger.start()
+
+    def stop(self):
+        if self._trigger:
+            self._trigger.stop()
+
+    # -- triggering ----------------------------------------------------------
+
+    def add_evaluation_task_if_needed(self, version: int):
+        """Step-based trigger (reference: :165-173)."""
+        if (
+            self._eval_steps
+            and version % self._eval_steps == 0
+            and version > self._last_eval_version
+        ):
+            self.add_evaluation_task()
+
+    def add_evaluation_task(self):
+        """Pin the current version + create eval tasks (reference: :131-148)."""
+        with self._lock:
+            if self._eval_job is not None:
+                return  # one eval at a time, like the reference
+            params, version = self._current_model_fn()
+            if params is None or version == self._last_eval_version:
+                return
+            self._checkpoint_service.save(params, version, is_eval=True)
+            n = self._task_d.create_evaluation_tasks(version)
+            self._eval_job = _EvaluationJob(version, total_tasks=n)
+            self._last_eval_version = version
+            logger.info("Evaluation job created at version %d (%d tasks)", version, n)
+
+    # -- worker reports ------------------------------------------------------
+
+    def report_metrics(self, model_version: int, metrics: Dict, num_examples: int):
+        with self._lock:
+            if self._eval_job is None or model_version != self._eval_job.model_version:
+                logger.warning(
+                    "Dropping metrics for version %d (no matching eval job)",
+                    model_version,
+                )
+                return
+            self._eval_job.report_metrics(metrics, num_examples)
+
+    def complete_task(self):
+        """Dispatcher callback when an EVALUATION task completes
+        (reference: :184-208)."""
+        finished_job = None
+        with self._lock:
+            if self._eval_job is None:
+                return
+            self._eval_job.complete_task()
+            if self._eval_job.finished():
+                finished_job = self._eval_job
+                self._eval_job = None
+        if finished_job is not None:
+            metrics = finished_job.get_metrics()
+            logger.info(
+                "Evaluation @v%d complete: %s", finished_job.model_version, metrics
+            )
+            self.completed_metrics.append((finished_job.model_version, metrics))
+            if self._metrics_writer:
+                self._metrics_writer(finished_job.model_version, metrics)
+            self._checkpoint_service.remove_eval_checkpoint(
+                finished_job.model_version
+            )

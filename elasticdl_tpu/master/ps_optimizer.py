@@ -1,0 +1,52 @@
+"""Host-side dense optimizer for the parameter server.
+
+The reference applies a TF optimizer to master-resident `tf.Variable`s
+inside `_update_model` (elasticdl/python/master/servicer.py:169-229).
+Here the PS state is a numpy pytree and the update is an optax
+transformation jitted on the *CPU* backend — PS math needs determinism
+and cheap serialization, not TPU FLOPs (SURVEY §7.1).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+import optax
+
+
+def _cpu_device():
+    return jax.local_devices(backend="cpu")[0]
+
+
+class PSOptimizer:
+    """Owns optax state for the dense parameter pytree."""
+
+    def __init__(self, optimizer: optax.GradientTransformation):
+        self._tx = optimizer
+        self._state = None
+        self._apply = None
+
+    def initialize(self, params: Any):
+        cpu = _cpu_device()
+        with jax.default_device(cpu):
+            self._state = self._tx.init(params)
+
+            def apply(params, grads, state):
+                updates, new_state = self._tx.update(grads, state, params)
+                return optax.apply_updates(params, updates), new_state
+
+            self._apply = jax.jit(apply)
+
+    @property
+    def initialized(self) -> bool:
+        return self._state is not None
+
+    def step(self, params: Any, grads: Any) -> Any:
+        """Apply averaged gradients; returns the new params pytree (numpy)."""
+        if self._state is None:
+            self.initialize(params)
+        with jax.default_device(_cpu_device()):
+            new_params, self._state = self._apply(params, grads, self._state)
+        return jax.tree_util.tree_map(np.asarray, new_params)

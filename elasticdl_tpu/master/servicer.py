@@ -1,0 +1,296 @@
+"""The master servicer: gRPC front-end + parameter server.
+
+Re-design of the reference's `MasterServicer`
+(elasticdl/python/master/servicer.py:21-423). The master holds the
+model as a numpy pytree + version counter, serves tasks and model
+pulls, and applies gradients:
+
+- **sync mode** (the reference's core, servicer.py:169-229, 305-402):
+  accept only gradients computed at the current version (optionally
+  within a staleness window — see below), accumulate, and on the
+  `grads_to_wait`-th report average dense grads, sparse-apply embedding
+  grads, run the optimizer, bump the version, and fire eval/checkpoint
+  hooks. `grads_to_wait` counts *reports*, not workers, so membership
+  churn never stalls a step.
+- **async mode** (designed but never landed in the reference,
+  doc/async_sgd_design.md:44-82): apply each report immediately,
+  optionally modulating the effective LR by 1/staleness.
+
+TPU-first deltas from the reference: gradients arrive *pre-reduced
+per host* (each gRPC worker is a TPU-VM host that already all-reduced
+over its local chips via shard_map — SURVEY §5.8), may be bf16 on the
+wire, and a `staleness_window > 0` relaxes strict version equality so
+churn-induced retry storms don't sink throughput (SURVEY §7.3 item 2).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from elasticdl_tpu.common.codec import IndexedRows, merge_indexed_rows
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.common.messages import MethodType, Task, TaskType
+from elasticdl_tpu.master.embedding_store import EmbeddingStore
+from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+from elasticdl_tpu.master.sparse_optimizer import SparseOptimizer
+
+logger = get_logger(__name__)
+
+
+def _to_f32(tree):
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a, dtype=np.float32)
+        if np.issubdtype(np.asarray(a).dtype, np.floating)
+        else np.asarray(a),
+        tree,
+    )
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        grads_to_wait: int,
+        optimizer: Optional[PSOptimizer] = None,
+        task_dispatcher=None,
+        evaluation_service=None,
+        checkpoint_service=None,
+        embedding_store: Optional[EmbeddingStore] = None,
+        sparse_optimizer: Optional[SparseOptimizer] = None,
+        init_params: Any = None,
+        init_version: int = 0,
+        use_async: bool = False,
+        lr_staleness_modulation: bool = False,
+        staleness_window: int = 0,
+    ):
+        self._lock = threading.Lock()
+        self._grads_to_wait = grads_to_wait
+        self._opt = optimizer
+        self._task_d = task_dispatcher
+        self._evaluation_service = evaluation_service
+        self._checkpoint_service = checkpoint_service
+        self._embedding_store = embedding_store
+        self._sparse_opt = sparse_optimizer
+        self._use_async = use_async
+        self._lr_staleness_modulation = lr_staleness_modulation
+        self._staleness_window = staleness_window
+
+        self._params = _to_f32(init_params) if init_params is not None else None
+        self._version = init_version
+        self._grad_sum: Any = None
+        self._grad_n = 0
+        self._edl_grads: Dict[str, list] = {}
+
+    # -- handler table (the 6 reference RPCs + embedding plane) -------------
+
+    def handlers(self) -> Dict[str, Any]:
+        return {
+            "GetTask": self.get_task,
+            "GetModel": self.get_model,
+            "ReportVariable": self.report_variable,
+            "ReportGradient": self.report_gradient,
+            "ReportEvaluationMetrics": self.report_evaluation_metrics,
+            "ReportTaskResult": self.report_task_result,
+            "EmbeddingLookup": self.embedding_lookup,
+            "EmbeddingUpdate": self.embedding_update,
+        }
+
+    # -- model state --------------------------------------------------------
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def model_initialized(self) -> bool:
+        return self._params is not None
+
+    def get_params_copy(self):
+        with self._lock:
+            return jax.tree_util.tree_map(np.copy, self._params), self._version
+
+    # -- RPC: tasks ---------------------------------------------------------
+
+    def get_task(self, req: dict) -> dict:
+        """reference: servicer.py:98-115 — next shard or WAIT."""
+        task = self._task_d.get(req["worker_id"]) if self._task_d else None
+        if task is None:
+            return {"task": Task(type=TaskType.WAIT).to_wire()}
+        return {"task": task.to_wire()}
+
+    def report_task_result(self, req: dict) -> dict:
+        """reference: servicer.py:408-414."""
+        err = req.get("err_message", "")
+        if err:
+            logger.warning("Worker reported error: %s", err)
+        self._task_d.report(req["task_id"], not err)
+        return {}
+
+    # -- RPC: model ---------------------------------------------------------
+
+    def get_model(self, req: dict) -> dict:
+        """reference: servicer.py:117-139 — MINIMUM serves the latest
+        under lock; FIXED serves an exact version from the evaluation
+        snapshot store."""
+        version = req.get("version", 0)
+        method = req.get("method", MethodType.MINIMUM)
+        if method == MethodType.MINIMUM:
+            with self._lock:
+                if self._params is None:
+                    return {"version": -1, "params": None}
+                return {
+                    "version": self._version,
+                    "params": jax.tree_util.tree_map(np.copy, self._params),
+                }
+        # FIXED
+        if self._checkpoint_service is None:
+            raise ValueError("FIXED model pull requires a checkpoint service")
+        model = self._checkpoint_service.get_eval_model(version)
+        if model is None:
+            model = self._checkpoint_service.load_version(version)
+        if model is None:
+            raise ValueError(f"no snapshot for model version {version}")
+        return {"version": model.version, "params": model.params}
+
+    def report_variable(self, req: dict) -> dict:
+        """Lazy model init from the first worker
+        (reference: servicer.py:299-303)."""
+        with self._lock:
+            if self._params is None:
+                self._params = _to_f32(req["params"])
+        return {}
+
+    # -- RPC: gradients (the hot path) --------------------------------------
+
+    def report_gradient(self, req: dict) -> dict:
+        """reference: servicer.py:305-402. Returns {accepted, version}."""
+        report_version = req.get("version", -1)
+        grads = req.get("gradient")
+        edl_grads: Dict[str, IndexedRows] = req.get("edl_gradient") or {}
+
+        with self._lock:
+            if self._params is None:
+                raise ValueError("gradient reported before model init")
+            staleness = self._version - report_version
+            if not self._use_async and staleness > self._staleness_window:
+                # stale: reject so the worker re-pulls and retries
+                # (reference: servicer.py:305-318)
+                return {"accepted": False, "version": self._version}
+            if report_version > self._version:
+                raise ValueError(
+                    f"future gradient version {report_version} > {self._version}"
+                )
+            self._validate(grads)
+
+            if self._use_async:
+                scale = 1.0
+                if self._lr_staleness_modulation and staleness > 1:
+                    # doc/async_sgd_design.md:75-82
+                    scale = 1.0 / float(staleness)
+                self._apply(grads, edl_grads, dense_scale=scale)
+                return {"accepted": True, "version": self._version}
+
+            # sync accumulate
+            if self._grad_sum is None:
+                self._grad_sum = jax.tree_util.tree_map(
+                    lambda g: np.asarray(g, dtype=np.float32).copy(), grads
+                )
+            else:
+                self._grad_sum = jax.tree_util.tree_map(
+                    lambda s, g: s + np.asarray(g, dtype=np.float32),
+                    self._grad_sum,
+                    grads,
+                )
+            for layer, ir in edl_grads.items():
+                self._edl_grads.setdefault(layer, []).append(ir)
+            self._grad_n += 1
+            if self._grad_n >= self._grads_to_wait:
+                avg = jax.tree_util.tree_map(
+                    lambda s: s / self._grad_n, self._grad_sum
+                )
+                merged = {
+                    layer: merge_indexed_rows(irs)
+                    for layer, irs in self._edl_grads.items()
+                }
+                self._apply(avg, merged)
+                self._grad_sum = None
+                self._grad_n = 0
+                self._edl_grads = {}
+            return {"accepted": True, "version": self._version}
+
+    def _validate(self, grads):
+        """Shape sanity checks (reference: servicer.py:320-370)."""
+        if grads is None:
+            return
+        flat_g, tree_g = jax.tree_util.tree_flatten(grads)
+        flat_p, tree_p = jax.tree_util.tree_flatten(self._params)
+        if tree_g != tree_p:
+            raise ValueError("gradient pytree does not match model pytree")
+        for g, p in zip(flat_g, flat_p):
+            if np.asarray(g).shape != np.asarray(p).shape:
+                raise ValueError(
+                    f"gradient shape {np.asarray(g).shape} != param shape "
+                    f"{np.asarray(p).shape}"
+                )
+
+    def _apply(self, dense_grads, edl_grads, dense_scale: float = 1.0):
+        """Optimizer step + version bump + hooks (caller holds the lock;
+        reference: servicer.py:169-229, 398-402)."""
+        if edl_grads and self._sparse_opt is not None:
+            self._sparse_opt.apply_gradients(edl_grads)
+        if dense_grads is not None and self._opt is not None:
+            if dense_scale != 1.0:
+                dense_grads = jax.tree_util.tree_map(
+                    lambda g: np.asarray(g, dtype=np.float32) * dense_scale,
+                    dense_grads,
+                )
+            self._params = self._opt.step(self._params, dense_grads)
+        self._version += 1
+        self._on_version_bump()
+
+    def _on_version_bump(self):
+        if self._checkpoint_service and self._checkpoint_service.need_to_checkpoint(
+            self._version
+        ):
+            self._checkpoint_service.save(self._params, self._version)
+        if self._evaluation_service:
+            self._evaluation_service.add_evaluation_task_if_needed(self._version)
+
+    # -- RPC: evaluation -----------------------------------------------------
+
+    def report_evaluation_metrics(self, req: dict) -> dict:
+        """Per-minibatch metric report (reference: servicer.py evaluation
+        path -> evaluation_service.py:28-46)."""
+        if self._evaluation_service:
+            self._evaluation_service.report_metrics(
+                req.get("model_version", -1),
+                req.get("metrics", {}),
+                req.get("num_examples", 1),
+            )
+        return {}
+
+    # -- RPC: embedding plane (replaces the Redis side channel) --------------
+
+    def embedding_lookup(self, req: dict) -> dict:
+        values, unknown = self._embedding_store.lookup(req["layer"], req["ids"])
+        return {"values": values, "unknown_index": unknown}
+
+    def embedding_update(self, req: dict) -> dict:
+        self._embedding_store.update(
+            req["layer"],
+            req["ids"],
+            req["values"],
+            set_if_not_exist=req.get("set_if_not_exist", False),
+        )
+        return {}
+
+    # -- checkpoint helpers (called from master main) ------------------------
+
+    def save_latest_checkpoint(self, output_path: str):
+        """reference: servicer.py:255-267."""
+        from elasticdl_tpu.master.checkpoint import save_model_file
+
+        with self._lock:
+            save_model_file(output_path, self._params, self._version)

@@ -1,0 +1,132 @@
+"""Sparse optimizer for PS-resident embedding tables.
+
+Equivalent of the reference's `OptimizerWrapper`
+(elasticdl/python/master/optimizer_wrapper.py:90-437): embedding rows
+*and their optimizer slots* live in the KV store; per step we dedup the
+gradient ids, batch-fetch rows+slots, run the update on the gathered
+[n, dim] matrices, and write rows+slots back. Supported optimizers
+mirror the reference's set (:117-135): SGD, SGD+momentum (nesterov),
+Adam, Adam+amsgrad.
+
+The update math runs in numpy on the master host — the batch is tiny
+(unique ids of one step) and determinism matters more than FLOPs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.codec import IndexedRows
+from elasticdl_tpu.master.embedding_store import EmbeddingStore
+
+_SLOT_SETS = {
+    "sgd": [],
+    "momentum": ["momentum"],
+    "adam": ["m", "v"],
+    "amsgrad": ["m", "v", "v_hat"],
+}
+
+
+def slot_layer_name(layer: str, slot: str) -> str:
+    """Slot rows live under a qualified layer name, mirroring the
+    reference's `layer-slot-id` keys (optimizer_wrapper.py:231-290)."""
+    return f"{layer}/slot/{slot}"
+
+
+def dedup_indexed_rows(g: IndexedRows) -> IndexedRows:
+    """Sum duplicate-id rows (reference: optimizer_wrapper.py:231-254)."""
+    uniq, inverse = np.unique(g.indices, return_inverse=True)
+    summed = np.zeros((len(uniq),) + g.values.shape[1:], dtype=np.float32)
+    np.add.at(summed, inverse, np.asarray(g.values, dtype=np.float32))
+    return IndexedRows(values=summed, indices=uniq)
+
+
+class SparseOptimizer:
+    def __init__(
+        self,
+        store: EmbeddingStore,
+        kind: str = "sgd",
+        learning_rate: float = 0.1,
+        momentum: float = 0.9,
+        nesterov: bool = False,
+        beta1: float = 0.9,
+        beta2: float = 0.999,
+        eps: float = 1e-8,
+    ):
+        if kind not in _SLOT_SETS:
+            raise ValueError(f"unsupported sparse optimizer: {kind}")
+        self._store = store
+        self._kind = kind
+        self._lr = learning_rate
+        self._momentum = momentum
+        self._nesterov = nesterov
+        self._b1, self._b2, self._eps = beta1, beta2, eps
+        self._step = 0  # adam bias-correction counter (global, like tf iterations)
+
+    @property
+    def slot_names(self) -> List[str]:
+        return list(_SLOT_SETS[self._kind])
+
+    def _fetch_slots(
+        self, layer: str, ids: np.ndarray, dim: int
+    ) -> Dict[str, np.ndarray]:
+        """Lazy-init unknown slot rows to zero
+        (reference: optimizer_wrapper.py:177-229)."""
+        slots = {}
+        for slot in self.slot_names:
+            values, unknown = self._store.lookup(slot_layer_name(layer, slot), ids)
+            if values.shape[1] == 0:
+                values = np.zeros((len(ids), dim), dtype=np.float32)
+            elif len(unknown):
+                values[unknown] = 0.0
+            slots[slot] = values
+        return slots
+
+    def apply_gradients(self, grads: Dict[str, IndexedRows]):
+        """Apply one step of sparse updates for each embedding layer
+        (reference: optimizer_wrapper.py:298-433)."""
+        self._step += 1
+        for layer, g in grads.items():
+            g = dedup_indexed_rows(g)
+            ids = g.indices
+            rows, unknown = self._store.lookup(layer, ids)
+            if rows.shape[1] == 0 or len(unknown):
+                raise ValueError(
+                    f"gradient for uninitialized embedding rows of layer "
+                    f"{layer!r}: {unknown[:8]!r}"
+                )
+            dim = rows.shape[1]
+            slots = self._fetch_slots(layer, ids, dim)
+            new_rows, new_slots = self._update(g.values, rows, slots)
+            self._store.update(layer, ids, new_rows)
+            for slot, vals in new_slots.items():
+                self._store.update(slot_layer_name(layer, slot), ids, vals)
+
+    def _update(
+        self, grad: np.ndarray, rows: np.ndarray, slots: Dict[str, np.ndarray]
+    ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        grad = np.asarray(grad, dtype=np.float32)
+        lr = self._lr
+        if self._kind == "sgd":
+            return rows - lr * grad, {}
+        if self._kind == "momentum":
+            buf = self._momentum * slots["momentum"] + grad
+            if self._nesterov:
+                step = grad + self._momentum * buf
+            else:
+                step = buf
+            return rows - lr * step, {"momentum": buf}
+        # adam / amsgrad
+        m = self._b1 * slots["m"] + (1 - self._b1) * grad
+        v = self._b2 * slots["v"] + (1 - self._b2) * grad * grad
+        m_hat = m / (1 - self._b1**self._step)
+        if self._kind == "amsgrad":
+            v_hat_slot = np.maximum(slots["v_hat"], v)
+            v_hat = v_hat_slot / (1 - self._b2**self._step)
+            new_slots = {"m": m, "v": v, "v_hat": v_hat_slot}
+        else:
+            v_hat = v / (1 - self._b2**self._step)
+            new_slots = {"m": m, "v": v}
+        return rows - lr * m_hat / (np.sqrt(v_hat) + self._eps), new_slots

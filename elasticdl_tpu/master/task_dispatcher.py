@@ -1,0 +1,157 @@
+"""Dynamic data sharder: the task queue that makes training elastic.
+
+Re-implementation of the reference's `_TaskDispatcher`
+(elasticdl/python/master/task_dispatcher.py:30-197) with identical
+semantics:
+
+- shards `{file: num_records}` into Tasks of `records_per_task` records;
+- shuffles training tasks per epoch and lazily rolls epochs;
+- `get(worker_id)` moves a task todo -> doing;
+- `report(task_id, success)` requeues failures;
+- `recover_tasks(worker_id)` requeues every in-flight task of a dead
+  worker — the entire fault-tolerance story (no checkpoint recovery);
+- evaluation tasks are pinned to a model version.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.common.messages import Task, TaskType
+
+logger = get_logger(__name__)
+
+
+class TaskDispatcher:
+    def __init__(
+        self,
+        training_shards: Dict[str, int],
+        evaluation_shards: Dict[str, int],
+        prediction_shards: Dict[str, int],
+        records_per_task: int,
+        num_epochs: int,
+    ):
+        self._lock = threading.Lock()
+        self._training_shards = training_shards
+        self._evaluation_shards = evaluation_shards
+        self._prediction_shards = prediction_shards
+        self._records_per_task = records_per_task
+        self._num_epochs = num_epochs
+        self._epoch = 0
+        self._task_id = 0
+        self._todo: list[Task] = []
+        # task_id -> (worker_id, task), mirrors reference :48-53
+        self._doing: Dict[int, Tuple[int, Task]] = {}
+        self._evaluation_service = None
+
+        if self._training_shards:
+            logger.info("Starting epoch %d", self._epoch)
+            self._create_training_tasks()
+        elif self._evaluation_shards:
+            self._create_tasks_no_lock(self._evaluation_shards, TaskType.EVALUATION)
+        elif self._prediction_shards:
+            self._create_tasks_no_lock(self._prediction_shards, TaskType.PREDICTION)
+
+    # -- task creation ------------------------------------------------------
+
+    def _shard_to_tasks(self, shards: Dict[str, int], task_type: str, model_version: int = -1):
+        tasks = []
+        for name, num_records in shards.items():
+            for start in range(0, num_records, self._records_per_task):
+                tasks.append(
+                    Task(
+                        task_id=-1,  # assigned at queue time
+                        shard_file_name=name,
+                        start=start,
+                        end=min(start + self._records_per_task, num_records),
+                        type=task_type,
+                        model_version=model_version,
+                    )
+                )
+        return tasks
+
+    def _create_training_tasks(self):
+        tasks = self._shard_to_tasks(self._training_shards, TaskType.TRAINING)
+        random.shuffle(tasks)  # per-epoch shuffle (reference :76-85)
+        self._extend_todo(tasks)
+
+    def _create_tasks_no_lock(self, shards, task_type, model_version=-1):
+        self._extend_todo(self._shard_to_tasks(shards, task_type, model_version))
+
+    def _extend_todo(self, tasks):
+        for t in tasks:
+            self._task_id += 1
+            t.task_id = self._task_id
+            self._todo.append(t)
+
+    def create_evaluation_tasks(self, model_version: int) -> int:
+        """Pin EVALUATION tasks to a model version (reference :87-99).
+        Returns the number of tasks created."""
+        with self._lock:
+            before = len(self._todo)
+            self._create_tasks_no_lock(
+                self._evaluation_shards, TaskType.EVALUATION, model_version
+            )
+            return len(self._todo) - before
+
+    def set_evaluation_service(self, evaluation_service):
+        self._evaluation_service = evaluation_service
+
+    # -- worker-facing ------------------------------------------------------
+
+    def get(self, worker_id: int) -> Optional[Task]:
+        """Pop the next task (todo -> doing); lazily roll the next epoch
+        (reference :130-151). Returns None when nothing is available."""
+        with self._lock:
+            if not self._todo and self._training_shards:
+                if self._epoch < self._num_epochs - 1:
+                    self._epoch += 1
+                    logger.info("Starting epoch %d", self._epoch)
+                    self._create_training_tasks()
+            if not self._todo:
+                return None
+            task = self._todo.pop(0)
+            self._doing[task.task_id] = (worker_id, task)
+            return task
+
+    def report(self, task_id: int, success: bool) -> bool:
+        """Worker reports task done/failed; failures are requeued
+        (reference :153-176). Returns False for unknown ids."""
+        evaluation_task_completed = None
+        with self._lock:
+            worker_and_task = self._doing.pop(task_id, None)
+            if worker_and_task is None:
+                logger.warning("Unknown task completion report: %d", task_id)
+                return False
+            _, task = worker_and_task
+            if not success:
+                logger.warning("Task %d failed, requeueing", task_id)
+                self._todo.append(task)
+            elif (
+                task.type == TaskType.EVALUATION
+                and self._evaluation_service is not None
+            ):
+                evaluation_task_completed = task
+        if evaluation_task_completed is not None:
+            self._evaluation_service.complete_task()
+        return True
+
+    def recover_tasks(self, worker_id: int):
+        """Requeue every in-flight task of a dead worker
+        (reference :182-190) — invoked from the pod-event callback."""
+        with self._lock:
+            ids = [
+                tid for tid, (wid, _) in self._doing.items() if wid == worker_id
+            ]
+        for tid in ids:
+            self.report(tid, False)
+
+    def finished(self) -> bool:
+        """All epochs exhausted and nothing in flight (reference :178-180)."""
+        with self._lock:
+            if self._training_shards and self._epoch < self._num_epochs - 1:
+                return False
+            return not self._todo and not self._doing

@@ -1,0 +1,36 @@
+"""Generic gRPC client: method-name-addressed unary calls with the
+pytree codec (see rpc/server.py). Replaces the generated MasterStub
+(reference: elasticdl/python/worker/main.py:88-97)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import grpc
+
+from elasticdl_tpu.common import messages
+from elasticdl_tpu.common.constants import GRPC_OPTIONS, SERVICE_NAME
+
+
+class RpcClient:
+    def __init__(self, addr: str, service_name: str = SERVICE_NAME):
+        self._channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+        self._service = service_name
+        self._calls: dict[str, Any] = {}
+
+    def wait_ready(self, timeout: float = 30.0):
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+
+    def call(self, method: str, request: Any = None, timeout: float = 300.0) -> Any:
+        if method not in self._calls:
+            self._calls[method] = self._channel.unary_unary(
+                f"/{self._service}/{method}",
+                request_serializer=None,
+                response_deserializer=None,
+            )
+        payload = messages.pack(request if request is not None else {})
+        resp = self._calls[method](payload, timeout=timeout)
+        return messages.unpack(resp)
+
+    def close(self):
+        self._channel.close()

@@ -1,0 +1,72 @@
+"""Generic gRPC server over raw-bytes methods.
+
+The reference compiles a .proto into stubs (elasticdl/Makefile:3-4); we
+instead register generic unary-unary handlers with identity serializers
+and run our own codec on the payloads — no codegen step, and the wire
+format supports bf16 and nested pytrees (see common/codec.py).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Callable, Dict
+
+import grpc
+
+from elasticdl_tpu.common import messages
+from elasticdl_tpu.common.constants import GRPC_OPTIONS, SERVICE_NAME
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+
+def _wrap(fn: Callable) -> Callable:
+    def handler(request_bytes: bytes, context) -> bytes:
+        req = messages.unpack(request_bytes) if request_bytes else None
+        try:
+            resp = fn(req) if req is not None else fn({})
+        except Exception:
+            logger.exception("RPC handler %s failed", fn.__name__)
+            context.abort(grpc.StatusCode.INTERNAL, "handler error")
+            raise
+        return messages.pack(resp)
+
+    return handler
+
+
+class RpcServer:
+    """Threaded gRPC server exposing `handlers` {method_name: fn(dict)->dict}.
+
+    Mirrors the reference master's 64-thread server
+    (elasticdl/python/master/main.py:197-223).
+    """
+
+    def __init__(
+        self,
+        handlers: Dict[str, Callable],
+        port: int = 0,
+        service_name: str = SERVICE_NAME,
+        max_workers: int = 64,
+    ):
+        method_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                _wrap(fn), request_deserializer=None, response_serializer=None
+            )
+            for name, fn in handlers.items()
+        }
+        generic = grpc.method_handlers_generic_handler(service_name, method_handlers)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=GRPC_OPTIONS,
+        )
+        self._server.add_generic_rpc_handlers((generic,))
+        self.port = self._server.add_insecure_port(f"[::]:{port}")
+
+    def start(self):
+        self._server.start()
+
+    def stop(self, grace: float = 0.5):
+        self._server.stop(grace)
+
+    def wait(self):
+        self._server.wait_for_termination()

@@ -1,0 +1,18 @@
+"""Test env: hermetic CPU-backend JAX with a virtual 8-device mesh.
+
+Mirrors the reference's testing posture — multi-node semantics tested
+on one machine (SURVEY §4.3) — using
+`--xla_force_host_platform_device_count=8` so sharding/collective code
+paths run without TPUs. TPU-gated tests opt in via EDL_TPU_TESTS=1,
+following the reference's K8S_TESTS env-switch pattern
+(elasticdl/python/tests/k8s_client_test.py:20-23).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

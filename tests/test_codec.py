@@ -1,0 +1,67 @@
+"""Codec round-trips (mirrors reference tests/ndarray_test.py)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import codec
+from elasticdl_tpu.common.codec import IndexedRows, merge_indexed_rows
+
+
+def test_roundtrip_dense():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    out = codec.loads(codec.dumps(a))
+    np.testing.assert_array_equal(a, out)
+    assert out.dtype == np.float32
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "int64", "uint8", "bool"])
+def test_roundtrip_dtypes(dtype):
+    a = np.ones((3, 5), dtype=dtype)
+    out = codec.loads(codec.dumps(a))
+    assert out.dtype == a.dtype
+    np.testing.assert_array_equal(a, out)
+
+
+def test_roundtrip_bfloat16():
+    import ml_dtypes
+
+    a = np.asarray([[1.5, -2.25], [0.0, 3.0]], dtype=ml_dtypes.bfloat16)
+    out = codec.loads(codec.dumps(a))
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(a.astype(np.float32), out.astype(np.float32))
+
+
+def test_roundtrip_pytree():
+    tree = {
+        "dense": {"w": np.ones((2, 2), dtype=np.float32), "b": np.zeros(2)},
+        "meta": {"version": 7, "name": "m"},
+        "list": [np.arange(3), "s", 1.5],
+    }
+    out = codec.loads(codec.dumps(tree))
+    np.testing.assert_array_equal(out["dense"]["w"], tree["dense"]["w"])
+    assert out["meta"] == {"version": 7, "name": "m"}
+    np.testing.assert_array_equal(out["list"][0], np.arange(3))
+
+
+def test_roundtrip_indexed_rows():
+    ir = IndexedRows(values=np.ones((3, 4), dtype=np.float32), indices=[7, 1, 3])
+    out = codec.loads(codec.dumps({"g": ir}))["g"]
+    assert isinstance(out, IndexedRows)
+    np.testing.assert_array_equal(out.indices, [7, 1, 3])
+    np.testing.assert_array_equal(out.values, ir.values)
+
+
+def test_merge_indexed_rows():
+    a = IndexedRows(values=np.ones((2, 3)), indices=[0, 1])
+    b = IndexedRows(values=2 * np.ones((1, 3)), indices=[5])
+    m = merge_indexed_rows([a, b])
+    np.testing.assert_array_equal(m.indices, [0, 1, 5])
+    assert m.values.shape == (3, 3)
+
+
+def test_jax_array_encodes():
+    import jax.numpy as jnp
+
+    a = jnp.ones((2, 2))
+    out = codec.loads(codec.dumps({"a": a}))["a"]
+    np.testing.assert_array_equal(out, np.ones((2, 2)))

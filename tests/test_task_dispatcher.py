@@ -1,0 +1,96 @@
+"""Dispatcher semantics (mirrors reference tests/task_dispatcher_test.py
+and the retry-accounting part of servicer_test.py:250-298)."""
+
+from elasticdl_tpu.common.messages import TaskType
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+def make(shards=None, epochs=1, rpt=10):
+    return TaskDispatcher(shards or {"f1": 25, "f2": 10}, {}, {}, rpt, epochs)
+
+
+def test_sharding_into_tasks():
+    d = make()
+    tasks = []
+    while True:
+        t = d.get(worker_id=0)
+        if t is None:
+            break
+        tasks.append(t)
+    # f1: [0,10) [10,20) [20,25); f2: [0,10)
+    assert len(tasks) == 4
+    spans = sorted((t.shard_file_name, t.start, t.end) for t in tasks)
+    assert spans == [("f1", 0, 10), ("f1", 10, 20), ("f1", 20, 25), ("f2", 0, 10)]
+    assert all(t.type == TaskType.TRAINING for t in tasks)
+
+
+def test_epoch_rollover():
+    d = make(shards={"f": 10}, epochs=3, rpt=10)
+    seen = 0
+    while True:
+        t = d.get(0)
+        if t is None:
+            break
+        seen += 1
+        d.report(t.task_id, True)
+    assert seen == 3  # one task per epoch x 3 epochs
+    assert d.finished()
+
+
+def test_failure_requeues():
+    d = make(shards={"f": 10}, epochs=1, rpt=10)
+    t = d.get(0)
+    assert not d.finished()
+    d.report(t.task_id, False)
+    t2 = d.get(1)
+    assert (t2.shard_file_name, t2.start, t2.end) == (
+        t.shard_file_name,
+        t.start,
+        t.end,
+    )
+    d.report(t2.task_id, True)
+    assert d.finished()
+
+
+def test_recover_tasks_requeues_only_dead_workers():
+    d = make(shards={"f": 40}, epochs=1, rpt=10)
+    t_dead = [d.get(7), d.get(7)]
+    t_live = d.get(3)
+    d.recover_tasks(7)
+    # the two dead-worker tasks are requeued; live worker's task stays doing
+    back = [d.get(9), d.get(9), d.get(9)]  # 1 undispatched + 2 recovered
+    assert d.get(9) is None
+    spans = {(t.start, t.end) for t in back}
+    assert {(t.start, t.end) for t in t_dead} <= spans
+    assert not d.finished()
+    for t in back + [t_live]:
+        d.report(t.task_id, True)
+    assert d.finished()
+
+
+def test_unknown_report_returns_false():
+    d = make()
+    assert d.report(12345, True) is False
+
+
+def test_evaluation_tasks_pinned_to_version():
+    d = TaskDispatcher({}, {"ev": 20}, {}, 10, 1)
+    t = d.get(0)
+    assert t.type == TaskType.EVALUATION
+    d2 = TaskDispatcher({"f": 10}, {"ev": 20}, {}, 10, 1)
+    n = d2.create_evaluation_tasks(model_version=42)
+    assert n == 2
+    types = []
+    while True:
+        t = d2.get(0)
+        if t is None:
+            break
+        types.append((t.type, t.model_version))
+    assert (TaskType.EVALUATION, 42) in types
+    assert sum(1 for ty, _ in types if ty == TaskType.EVALUATION) == 2
+
+
+def test_prediction_only():
+    d = TaskDispatcher({}, {}, {"p": 15}, 10, 1)
+    t = d.get(0)
+    assert t.type == TaskType.PREDICTION
