@@ -1,0 +1,140 @@
+"""Elastic embedding: PS-resident tables with a functional BET pattern.
+
+The reference's `elasticdl.layers.Embedding`
+(elasticdl/python/elasticdl/layers/embedding.py:5-180) is a Keras layer
+with no `input_dim` (unbounded vocab; rows live in a KV store). Its
+forward pass does a `tf.py_function` host call mid-graph and captures
+per-row gradients via `tape.watch(BET)` (:108-116).
+
+The TPU-native design inverts this (SURVEY §7.1): the **Batch Embedding
+Tensor** (BET — the gathered unique-id rows, design doc
+distributed_embedding_layer_design.md:220-266) is fetched on the host
+*outside* jit and passed into the jitted step as a regular argument.
+`jax.grad` w.r.t. that argument then yields exactly the per-row
+gradients the tape trick produced — no host calls inside the graph, and
+the jitted step stays static-shaped because unique-id counts are padded
+to power-of-two buckets (SURVEY §7.3 item 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class EmbeddingSpec:
+    """Declares one PS-resident embedding table used by a model.
+
+    `input_key` names the integer-id feature ([B] or [B, L]) feeding the
+    table. `combiner`/`mask_zero` mirror the reference layer's options
+    (layers/embedding.py:127-153; mask_zero used by
+    model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py:27-33).
+    """
+
+    name: str
+    dim: int
+    input_key: str
+    combiner: Optional[str] = None  # None | "sum" | "mean" | "sqrtn"
+    mask_zero: bool = False
+    init_scale: float = 0.05  # rows init ~ U(-scale, scale)
+
+
+def bucket_size(n: int, minimum: int = 8) -> int:
+    """Pad unique-id counts to power-of-two buckets so jit sees only
+    O(log vocab-per-batch) distinct shapes."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class BatchEmbedding:
+    """Host-side prepared embedding inputs for one minibatch.
+
+    bet:      [bucket, dim] float32 — padded unique rows (device input)
+    inverse:  [B, L] int32 — position of each id's row in `bet`
+    mask:     [B, L] bool — False where the id is masked padding
+    ids:      [n_unique] int64 host array — for gradient reporting
+    """
+
+    bet: np.ndarray
+    inverse: np.ndarray
+    mask: np.ndarray
+    ids: np.ndarray
+
+
+def prepare_batch_embedding(
+    spec: EmbeddingSpec, ids: np.ndarray, lookup_fn
+) -> BatchEmbedding:
+    """Host pre-pass: dedup ids, fetch rows (lazy-init via `lookup_fn`),
+    pad to a bucket. `lookup_fn(spec, unique_ids) -> [n, dim]`."""
+    ids = np.asarray(ids)
+    if ids.ndim == 1:
+        ids = ids[:, None]
+    flat = ids.reshape(-1).astype(np.int64)
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    rows = lookup_fn(spec, uniq)
+    bucket = bucket_size(len(uniq))
+    bet = np.zeros((bucket, spec.dim), dtype=np.float32)
+    bet[: len(uniq)] = rows
+    mask = ids != 0 if spec.mask_zero else np.ones_like(ids, dtype=bool)
+    return BatchEmbedding(
+        bet=bet,
+        inverse=inverse.reshape(ids.shape).astype(np.int32),
+        mask=mask,
+        ids=uniq,
+    )
+
+
+def embedding_forward(
+    bet: jnp.ndarray,
+    inverse: jnp.ndarray,
+    mask: jnp.ndarray,
+    combiner: Optional[str] = None,
+) -> jnp.ndarray:
+    """Device-side re-expansion of the BET (pure, jit-safe).
+
+    Dense path (reference: layers/embedding.py:98-125): returns
+    [B, L, dim] (masked rows zeroed). Combiner path (:127-153):
+    sum/mean/sqrtn over L -> [B, dim].
+    """
+    gathered = bet[inverse]  # [B, L, dim]
+    m = mask[..., None].astype(bet.dtype)
+    gathered = gathered * m
+    if combiner is None:
+        return gathered
+    s = jnp.sum(gathered, axis=1)  # [B, dim]
+    if combiner == "sum":
+        return s
+    counts = jnp.maximum(jnp.sum(mask.astype(bet.dtype), axis=1, keepdims=True), 1.0)
+    if combiner == "mean":
+        return s / counts
+    if combiner == "sqrtn":
+        return s / jnp.sqrt(counts)
+    raise ValueError(f"unknown combiner {combiner!r}")
+
+
+def extract_indexed_grads(
+    spec: EmbeddingSpec, bet_grad: np.ndarray, batch: BatchEmbedding
+):
+    """Slice the padded BET gradient back to real rows -> IndexedRows.
+
+    Equivalent of the reference worker shipping (bet_grad, ids) pairs as
+    IndexedSlices (layers/embedding.py:108-116, worker.py:189-247).
+    Rows for masked id 0 are dropped when mask_zero is set (padding ids
+    must not learn).
+    """
+    from elasticdl_tpu.common.codec import IndexedRows
+
+    n = len(batch.ids)
+    values = np.asarray(bet_grad[:n], dtype=np.float32)
+    ids = batch.ids
+    if spec.mask_zero:
+        keep = ids != 0
+        values, ids = values[keep], ids[keep]
+    return IndexedRows(values=values, indices=ids)

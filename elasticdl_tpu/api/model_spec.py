@@ -1,0 +1,123 @@
+"""Model-zoo contract loader.
+
+Re-design of the reference's model spec resolution
+(elasticdl/python/common/model_helper.py:79-125). A model-zoo module
+exports:
+
+- ``custom_model()`` -> a flax ``nn.Module`` (or any object with
+  ``init(rng, *sample)`` / ``apply(params, *inputs)``) — the
+  functional-API / subclass duality of the reference collapses to "any
+  flax module";
+- ``dataset_fn(records, mode)`` -> ``(features, labels)`` numpy batch
+  parsed from a list of raw record payloads (the reference maps a
+  tf.data.Dataset, elasticdl/doc/model_building.md:33-60; here the
+  worker hands the batch of raw records straight to the parser —
+  vectorized decode, no TF);
+- ``loss(outputs, labels)`` -> scalar (jnp);
+- ``optimizer()`` -> ``optax.GradientTransformation``;
+- ``eval_metrics_fn(predictions, labels)`` -> dict of scalars;
+- optional ``embedding_specs`` -> list[EmbeddingSpec] declaring
+  PS-resident tables (replaces implicit Embedding-layer discovery via
+  ``find_layer``, model_helper.py:143-154);
+- optional ``sparse_optimizer`` -> dict(kind=..., learning_rate=...)
+  for the PS-side sparse table updates;
+- optional ``PredictionOutputsProcessor`` class
+  (reference: worker/prediction_outputs_processor.py:4-22).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import importlib.util
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticdl_tpu.api.layers import EmbeddingSpec
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    model: Any
+    dataset_fn: Callable
+    loss: Callable
+    optimizer: Callable
+    eval_metrics_fn: Optional[Callable] = None
+    embedding_specs: List[EmbeddingSpec] = dataclasses.field(default_factory=list)
+    sparse_optimizer: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    prediction_outputs_processor: Any = None
+    module: Any = None
+
+
+def load_module(module_file: str):
+    """Dynamic import of a model-zoo file
+    (reference: model_helper.py:10-14)."""
+    spec = importlib.util.spec_from_file_location(module_file, module_file)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def parse_model_params(model_params: str) -> Dict[str, Any]:
+    """Parse ``"k=v,k2=v2"`` constructor params
+    (reference: model_helper.py:27-32, minus the raw ``eval``)."""
+    out: Dict[str, Any] = {}
+    if not model_params:
+        return out
+    import ast
+
+    for kv in model_params.split(","):
+        if not kv.strip():
+            continue
+        k, v = kv.split("=", 1)
+        try:
+            out[k.strip()] = ast.literal_eval(v.strip())
+        except (ValueError, SyntaxError):
+            out[k.strip()] = v.strip()
+    return out
+
+
+def get_model_spec(
+    model_zoo: str,
+    model_def: str,
+    model_params: str = "",
+    dataset_fn: str = "dataset_fn",
+    loss: str = "loss",
+    optimizer: str = "optimizer",
+    eval_metrics_fn: str = "eval_metrics_fn",
+    prediction_outputs_processor: str = "PredictionOutputsProcessor",
+) -> ModelSpec:
+    """Resolve the named spec functions from a model-zoo module
+    (reference: model_helper.py:79-125). ``model_def`` is
+    ``"pkg.file.symbol"`` relative to ``model_zoo`` or an absolute file
+    path plus symbol."""
+    *module_parts, symbol = model_def.split(".")
+    module_file = os.path.join(model_zoo, *module_parts) + ".py"
+    if not os.path.exists(module_file):
+        # allow "pkg.file" style where file == symbol container module
+        raise FileNotFoundError(f"model_def module not found: {module_file}")
+    module = load_module(module_file)
+
+    model_factory = getattr(module, symbol)
+    params = parse_model_params(model_params)
+    model = model_factory(**params) if callable(model_factory) else model_factory
+
+    def resolve(name, required=True):
+        fn = getattr(module, name, None)
+        if fn is None and required:
+            raise ValueError(f"model module must define {name!r}")
+        return fn
+
+    processor_cls = getattr(module, prediction_outputs_processor, None)
+    return ModelSpec(
+        model=model,
+        dataset_fn=resolve(dataset_fn),
+        loss=resolve(loss),
+        optimizer=resolve(optimizer),
+        eval_metrics_fn=resolve(eval_metrics_fn, required=False),
+        embedding_specs=list(getattr(module, "embedding_specs", []) or []),
+        sparse_optimizer=dict(getattr(module, "sparse_optimizer", {}) or {}),
+        prediction_outputs_processor=processor_cls() if processor_cls else None,
+        module=module,
+    )

@@ -60,17 +60,22 @@ class Task:
 @dataclasses.dataclass
 class Model:
     """Versioned parameter pytree (reference: elasticdl.proto:57-60,
-    generalized from a flat name->Tensor map to a nested pytree)."""
+    generalized from a flat name->Tensor map to a nested pytree).
+
+    `aux` carries non-trainable collections (e.g. flax batch_stats);
+    the reference's TF variables mix both, JAX separates them.
+    """
 
     version: int = 0
-    params: Any = None  # pytree of np.ndarray
+    params: Any = None  # trainable pytree of np.ndarray
+    aux: Any = None  # non-trainable state pytree (or None)
 
     def to_wire(self) -> dict:
-        return {"version": self.version, "params": self.params}
+        return {"version": self.version, "params": self.params, "aux": self.aux}
 
     @classmethod
     def from_wire(cls, d: dict) -> "Model":
-        return cls(version=d["version"], params=d["params"])
+        return cls(version=d["version"], params=d["params"], aux=d.get("aux"))
 
 
 def pack(obj: Any) -> bytes:

@@ -30,10 +30,14 @@ logger = get_logger(__name__)
 
 
 def save_model_file(
-    path: str, params: Any, version: int, embeddings: Optional[Dict] = None
+    path: str,
+    params: Any,
+    version: int,
+    aux: Any = None,
+    embeddings: Optional[Dict] = None,
 ):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    payload = {"version": version, "params": params}
+    payload = {"version": version, "params": params, "aux": aux}
     if embeddings is not None:
         payload["embeddings"] = embeddings
     tmp = path + ".tmp"
@@ -45,7 +49,7 @@ def save_model_file(
 def load_model_file(path: str) -> Model:
     with open(path, "rb") as f:
         d = codec.loads(f.read())
-    m = Model(version=d["version"], params=d["params"])
+    m = Model(version=d["version"], params=d["params"], aux=d.get("aux"))
     m.embeddings = d.get("embeddings")  # type: ignore[attr-defined]
     return m
 
@@ -83,13 +87,13 @@ class CheckpointService:
         d = self._eval_checkpoint_dir if is_eval else self._directory
         return os.path.join(d, f"model_v{version}.ckpt")
 
-    def save(self, params: Any, version: int, is_eval: bool = False):
+    def save(self, params: Any, version: int, is_eval: bool = False, aux: Any = None):
         """reference: checkpoint_service.py:47-72 (rotation included)."""
         path = self._path(version, is_eval)
         emb = None
         if not is_eval and self._embedding_store is not None:
             emb = self._embedding_store.snapshot()
-        save_model_file(path, params, version, embeddings=emb)
+        save_model_file(path, params, version, aux=aux, embeddings=emb)
         if is_eval:
             self._eval_models[version] = path
         else:

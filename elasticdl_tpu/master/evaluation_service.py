@@ -97,7 +97,7 @@ class EvaluationService:
         self._checkpoint_service = checkpoint_service
         self._task_d = task_dispatcher
         self._eval_steps = eval_steps
-        self._current_model_fn = current_model_fn  # () -> (params, version)
+        self._current_model_fn = current_model_fn  # () -> (params, aux, version)
         self._metrics_writer = metrics_writer
         self._lock = threading.Lock()
         self._eval_job: Optional[_EvaluationJob] = None
@@ -111,6 +111,12 @@ class EvaluationService:
     def stop(self):
         if self._trigger:
             self._trigger.stop()
+
+    def has_pending(self) -> bool:
+        """True while an eval job is in flight — workers must not exit
+        (the master's finished signal consults this)."""
+        with self._lock:
+            return self._eval_job is not None
 
     # -- triggering ----------------------------------------------------------
 
@@ -128,10 +134,10 @@ class EvaluationService:
         with self._lock:
             if self._eval_job is not None:
                 return  # one eval at a time, like the reference
-            params, version = self._current_model_fn()
+            params, aux, version = self._current_model_fn()
             if params is None or version == self._last_eval_version:
                 return
-            self._checkpoint_service.save(params, version, is_eval=True)
+            self._checkpoint_service.save(params, version, is_eval=True, aux=aux)
             n = self._task_d.create_evaluation_tasks(version)
             self._eval_job = _EvaluationJob(version, total_tasks=n)
             self._last_eval_version = version

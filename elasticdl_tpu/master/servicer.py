@@ -61,6 +61,7 @@ class MasterServicer:
         embedding_store: Optional[EmbeddingStore] = None,
         sparse_optimizer: Optional[SparseOptimizer] = None,
         init_params: Any = None,
+        init_aux: Any = None,
         init_version: int = 0,
         use_async: bool = False,
         lr_staleness_modulation: bool = False,
@@ -79,8 +80,13 @@ class MasterServicer:
         self._staleness_window = staleness_window
 
         self._params = _to_f32(init_params) if init_params is not None else None
+        # non-trainable collections (e.g. batch_stats) — restored from a
+        # checkpoint alongside init_params, or lazily set by the first
+        # worker's ReportVariable
+        self._aux = init_aux
         self._version = init_version
         self._grad_sum: Any = None
+        self._pending_aux: Any = None
         self._grad_n = 0
         self._edl_grads: Dict[str, list] = {}
 
@@ -109,16 +115,31 @@ class MasterServicer:
 
     def get_params_copy(self):
         with self._lock:
-            return jax.tree_util.tree_map(np.copy, self._params), self._version
+            return (
+                jax.tree_util.tree_map(np.copy, self._params),
+                jax.tree_util.tree_map(np.copy, self._aux),
+                self._version,
+            )
 
     # -- RPC: tasks ---------------------------------------------------------
 
     def get_task(self, req: dict) -> dict:
-        """reference: servicer.py:98-115 — next shard or WAIT."""
+        """reference: servicer.py:98-115 — next shard or WAIT.
+
+        Adds an explicit `finished` flag so workers exit cleanly instead
+        of inferring job completion from an empty shard name."""
         task = self._task_d.get(req["worker_id"]) if self._task_d else None
         if task is None:
-            return {"task": Task(type=TaskType.WAIT).to_wire()}
-        return {"task": task.to_wire()}
+            finished = self._task_d.finished() if self._task_d else True
+            # keep workers alive while an evaluation job is still pending:
+            # its EVALUATION tasks may not have been enqueued yet
+            if finished and self._evaluation_service is not None:
+                finished = not self._evaluation_service.has_pending()
+            return {
+                "task": Task(type=TaskType.WAIT).to_wire(),
+                "finished": finished,
+            }
+        return {"task": task.to_wire(), "finished": False}
 
     def report_task_result(self, req: dict) -> dict:
         """reference: servicer.py:408-414."""
@@ -139,10 +160,16 @@ class MasterServicer:
         if method == MethodType.MINIMUM:
             with self._lock:
                 if self._params is None:
-                    return {"version": -1, "params": None}
+                    return {"version": -1, "params": None, "aux": None}
+                if req.get("only_if_newer") and self._version <= version:
+                    # Bandwidth saver over the reference's always-full
+                    # model pulls (servicer.py:282-287): the worker
+                    # already holds this version.
+                    return {"version": self._version, "params": None, "aux": None}
                 return {
                     "version": self._version,
                     "params": jax.tree_util.tree_map(np.copy, self._params),
+                    "aux": jax.tree_util.tree_map(np.copy, self._aux),
                 }
         # FIXED
         if self._checkpoint_service is None:
@@ -152,7 +179,7 @@ class MasterServicer:
             model = self._checkpoint_service.load_version(version)
         if model is None:
             raise ValueError(f"no snapshot for model version {version}")
-        return {"version": model.version, "params": model.params}
+        return {"version": model.version, "params": model.params, "aux": model.aux}
 
     def report_variable(self, req: dict) -> dict:
         """Lazy model init from the first worker
@@ -160,6 +187,8 @@ class MasterServicer:
         with self._lock:
             if self._params is None:
                 self._params = _to_f32(req["params"])
+                if req.get("aux") is not None:
+                    self._aux = req["aux"]
         return {}
 
     # -- RPC: gradients (the hot path) --------------------------------------
@@ -169,6 +198,7 @@ class MasterServicer:
         report_version = req.get("version", -1)
         grads = req.get("gradient")
         edl_grads: Dict[str, IndexedRows] = req.get("edl_gradient") or {}
+        aux_state = req.get("aux_state")
 
         with self._lock:
             if self._params is None:
@@ -189,7 +219,7 @@ class MasterServicer:
                 if self._lr_staleness_modulation and staleness > 1:
                     # doc/async_sgd_design.md:75-82
                     scale = 1.0 / float(staleness)
-                self._apply(grads, edl_grads, dense_scale=scale)
+                self._apply(grads, edl_grads, dense_scale=scale, aux_state=aux_state)
                 return {"accepted": True, "version": self._version}
 
             # sync accumulate
@@ -205,6 +235,8 @@ class MasterServicer:
                 )
             for layer, ir in edl_grads.items():
                 self._edl_grads.setdefault(layer, []).append(ir)
+            if aux_state is not None:
+                self._pending_aux = aux_state
             self._grad_n += 1
             if self._grad_n >= self._grads_to_wait:
                 avg = jax.tree_util.tree_map(
@@ -214,7 +246,8 @@ class MasterServicer:
                     layer: merge_indexed_rows(irs)
                     for layer, irs in self._edl_grads.items()
                 }
-                self._apply(avg, merged)
+                self._apply(avg, merged, aux_state=self._pending_aux)
+                self._pending_aux = None
                 self._grad_sum = None
                 self._grad_n = 0
                 self._edl_grads = {}
@@ -235,9 +268,12 @@ class MasterServicer:
                     f"{np.asarray(p).shape}"
                 )
 
-    def _apply(self, dense_grads, edl_grads, dense_scale: float = 1.0):
+    def _apply(self, dense_grads, edl_grads, dense_scale: float = 1.0, aux_state=None):
         """Optimizer step + version bump + hooks (caller holds the lock;
-        reference: servicer.py:169-229, 398-402)."""
+        reference: servicer.py:169-229, 398-402). Non-trainable state
+        (BN moving stats) is last-writer-wins from the reporting hosts."""
+        if aux_state is not None:
+            self._aux = aux_state
         if edl_grads and self._sparse_opt is not None:
             self._sparse_opt.apply_gradients(edl_grads)
         if dense_grads is not None and self._opt is not None:
@@ -254,7 +290,7 @@ class MasterServicer:
         if self._checkpoint_service and self._checkpoint_service.need_to_checkpoint(
             self._version
         ):
-            self._checkpoint_service.save(self._params, self._version)
+            self._checkpoint_service.save(self._params, self._version, aux=self._aux)
         if self._evaluation_service:
             self._evaluation_service.add_evaluation_task_if_needed(self._version)
 
@@ -293,4 +329,4 @@ class MasterServicer:
         from elasticdl_tpu.master.checkpoint import save_model_file
 
         with self._lock:
-            save_model_file(output_path, self._params, self._version)
+            save_model_file(output_path, self._params, self._version, aux=self._aux)

@@ -1,0 +1,41 @@
+"""Device-mesh helpers.
+
+The reference has no notion of a device mesh — its only multi-device
+story is one GPU per worker pod. On TPU the unit of elasticity is a
+*host* (TPU-VM) driving several local chips; each gRPC worker
+all-reduces over its local chips via XLA collectives and reports one
+pre-reduced gradient (SURVEY §5.8). These helpers build the meshes for
+that local data parallelism and for the full tp/pp/dp/sp shardings used
+by `parallel.sharding`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_mesh(num_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
+    """1-D mesh over this host's local devices (the in-worker DP mesh)."""
+    devs = jax.local_devices()
+    n = num_devices or len(devs)
+    return Mesh(np.asarray(devs[:n]), (axis,))
+
+
+def make_mesh(shape: Sequence[int], axes: Sequence[str]) -> Mesh:
+    """N-D mesh over all visible devices, e.g. make_mesh((2, 4), ("dp", "tp")).
+
+    Axis order follows the scaling-book convention: put the
+    fastest-communicating axis (tp/sp) innermost so its collectives ride
+    adjacent ICI links.
+    """
+    if int(np.prod(shape)) > len(jax.devices()):
+        raise ValueError(
+            f"mesh shape {tuple(shape)} needs {int(np.prod(shape))} devices, "
+            f"have {len(jax.devices())}"
+        )
+    devs = np.asarray(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, tuple(axes))

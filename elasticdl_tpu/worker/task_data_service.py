@@ -1,0 +1,84 @@
+"""Worker-side data plumbing: record readers + minibatch prefetch.
+
+The reference overlaps I/O with compute by wrapping GetTask-driven
+record generation in tf.data with prefetch
+(elasticdl/python/worker/task_data_service.py:77-136 and
+doc/worker_optimization_design.md). TF-free equivalent: a reader cache
+of mmapped RecordIO files plus a background-thread minibatch parser
+(double-buffered queue) so host-side decode overlaps device compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterator, List, Optional
+
+from elasticdl_tpu.data.recordio import RecordIOReader
+
+
+class ReaderCache:
+    """Open (mmapped) RecordIO readers keyed by path."""
+
+    def __init__(self):
+        self._readers: Dict[str, RecordIOReader] = {}
+
+    def get(self, path: str) -> RecordIOReader:
+        r = self._readers.get(path)
+        if r is None:
+            r = RecordIOReader(path)
+            self._readers[path] = r
+        return r
+
+    def close(self):
+        for r in self._readers.values():
+            r.close()
+        self._readers.clear()
+
+
+def iter_minibatches(
+    records: List[bytes], minibatch_size: int
+) -> Iterator[List[bytes]]:
+    for i in range(0, len(records), minibatch_size):
+        yield records[i : i + minibatch_size]
+
+
+class PrefetchParser:
+    """Parses raw-record minibatches on a daemon thread.
+
+    `parse(chunk)` runs ahead of the consumer by `depth` minibatches —
+    the moral equivalent of `.prefetch(1)` in the reference's pipeline
+    (worker/worker.py:446-447).
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        chunks: Iterator[List[bytes]],
+        parse: Callable,
+        depth: int = 2,
+    ):
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._error: Optional[BaseException] = None
+
+        def run():
+            try:
+                for chunk in chunks:
+                    self._q.put(parse(chunk))
+            except BaseException as e:  # propagate to consumer
+                self._error = e
+            finally:
+                self._q.put(self._DONE)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item

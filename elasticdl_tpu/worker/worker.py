@@ -1,0 +1,514 @@
+"""The worker: stateless data-plane client of the master/PS.
+
+Re-design of the reference worker
+(elasticdl/python/worker/worker.py:23-463) on JAX:
+
+- the training step is `jax.value_and_grad` jitted once and reused
+  (the reference's `@tf.function` switch-off for embedding models,
+  worker.py:301-308, disappears: embedding rows are fetched on the host
+  *before* the jitted step, so everything always compiles);
+- local chips form a 1-D `dp` mesh; the batch is sharded over it and
+  XLA's all-reduce pre-reduces gradients across local devices, so each
+  gRPC report carries one host-level gradient (SURVEY §5.8);
+- the sync-SGD retry protocol is preserved: pull model -> compute ->
+  report; on version rejection re-pull and retry the same minibatch,
+  up to MAX_MINIBATCH_RETRY_NUM (reference worker.py:347-388);
+- model pulls use `only_if_newer` delta semantics to skip redundant
+  full-model payloads (an improvement over servicer.py:282-287);
+- gradients can ride the wire as bfloat16 (`transport_dtype`).
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticdl_tpu.api.layers import (
+    BatchEmbedding,
+    EmbeddingSpec,
+    extract_indexed_grads,
+    prepare_batch_embedding,
+)
+from elasticdl_tpu.api.model_spec import ModelSpec
+from elasticdl_tpu.common.constants import MAX_MINIBATCH_RETRY_NUM, Mode
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.common.messages import MethodType, Task, TaskType
+from elasticdl_tpu.worker.task_data_service import (
+    PrefetchParser,
+    ReaderCache,
+    iter_minibatches,
+)
+
+logger = get_logger(__name__)
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BF16 = None
+
+
+class EmbeddingInput(NamedTuple):
+    """Device-side view of one embedding table's batch slice."""
+
+    bet: Any  # [bucket, dim]
+    inverse: Any  # [B, L] int32
+    mask: Any  # [B, L] bool
+
+
+class Worker:
+    def __init__(
+        self,
+        worker_id: int,
+        master,  # object with .call(method, request) -> dict
+        model_spec: ModelSpec,
+        minibatch_size: int,
+        mesh=None,  # optional local dp Mesh for multi-chip hosts
+        transport_dtype: str = "float32",
+        seed: int = 0,
+    ):
+        self._id = worker_id
+        self._master = master
+        self._spec = model_spec
+        self._minibatch_size = minibatch_size
+        self._mesh = mesh
+        self._transport_dtype = transport_dtype
+        self._rng = jax.random.PRNGKey(seed + worker_id)
+
+        self._params = None  # trainable pytree (device)
+        self._aux: Dict[str, Any] = {}  # non-trainable collections
+        self._version = -1
+
+        self._readers = ReaderCache()
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._model_takes_train_kwarg: Optional[bool] = None
+
+        self._emb_specs: Dict[str, EmbeddingSpec] = {
+            s.name: s for s in model_spec.embedding_specs
+        }
+
+    # ------------------------------------------------------------------ RPCs
+
+    def get_task(self):
+        resp = self._master.call("GetTask", {"worker_id": self._id})
+        return Task.from_wire(resp["task"]), resp.get("finished", False)
+
+    def pull_model(self, min_version: int = -1, method: str = MethodType.MINIMUM):
+        """reference: worker.py:103-124 (var assign becomes pytree swap)."""
+        req = {"version": min_version, "method": method}
+        if method == MethodType.MINIMUM:
+            req["only_if_newer"] = True
+            req["version"] = self._version
+        resp = self._master.call("GetModel", req)
+        if resp["version"] < 0:
+            return False  # master model not initialized yet
+        if resp["params"] is not None:
+            self._params = jax.tree_util.tree_map(jnp.asarray, resp["params"])
+            self._aux = (
+                jax.tree_util.tree_map(jnp.asarray, resp["aux"])
+                if resp.get("aux")
+                else {}
+            )
+        self._version = resp["version"]
+        return True
+
+    def report_variable(self):
+        self._master.call(
+            "ReportVariable",
+            {
+                "params": jax.tree_util.tree_map(np.asarray, self._params),
+                "aux": jax.tree_util.tree_map(np.asarray, self._aux)
+                if self._aux
+                else None,
+            },
+        )
+
+    def report_gradient(self, grads, edl_grads, aux_state):
+        grads_np = jax.tree_util.tree_map(self._to_wire_dtype, grads)
+        return self._master.call(
+            "ReportGradient",
+            {
+                "worker_id": self._id,
+                "version": self._version,
+                "gradient": grads_np,
+                "edl_gradient": edl_grads or None,
+                "aux_state": jax.tree_util.tree_map(np.asarray, aux_state)
+                if aux_state
+                else None,
+            },
+        )
+
+    def _to_wire_dtype(self, g):
+        g = np.asarray(g)
+        if (
+            self._transport_dtype == "bfloat16"
+            and _BF16 is not None
+            and np.issubdtype(g.dtype, np.floating)
+        ):
+            return g.astype(_BF16)
+        return g
+
+    def report_task_result(self, task_id: int, err: str = ""):
+        self._master.call(
+            "ReportTaskResult", {"task_id": task_id, "err_message": err}
+        )
+
+    # ------------------------------------------------------- embedding plane
+
+    def lookup_embedding(self, spec: EmbeddingSpec, ids: np.ndarray) -> np.ndarray:
+        """Fetch rows with lazy init of unseen ids
+        (reference: worker.py:126-169)."""
+        resp = self._master.call("EmbeddingLookup", {"layer": spec.name, "ids": ids})
+        values, unknown = resp["values"], resp["unknown_index"]
+        if values.shape[1] == 0:
+            values = np.zeros((len(ids), spec.dim), dtype=np.float32)
+        else:
+            values = np.array(values)  # decoded buffers are read-only views
+        if len(unknown):
+            self._rng, sub = jax.random.split(self._rng)
+            init = np.asarray(
+                jax.random.uniform(
+                    sub,
+                    (len(unknown), spec.dim),
+                    minval=-spec.init_scale,
+                    maxval=spec.init_scale,
+                )
+            ).astype(np.float32)
+            unknown_ids = np.asarray(ids)[np.asarray(unknown)]
+            # SETNX so a concurrent worker's init wins once, globally
+            self._master.call(
+                "EmbeddingUpdate",
+                {
+                    "layer": spec.name,
+                    "ids": unknown_ids,
+                    "values": init,
+                    "set_if_not_exist": True,
+                },
+            )
+            resp2 = self._master.call(
+                "EmbeddingLookup", {"layer": spec.name, "ids": unknown_ids}
+            )
+            if len(resp2["unknown_index"]):
+                raise RuntimeError("embedding rows missing after lazy init")
+            values[np.asarray(unknown)] = resp2["values"]
+        return values
+
+    def _prepare_embeddings(self, features) -> Dict[str, BatchEmbedding]:
+        return {
+            name: prepare_batch_embedding(
+                spec, features[spec.input_key], self.lookup_embedding
+            )
+            for name, spec in self._emb_specs.items()
+        }
+
+    # ------------------------------------------------------------ jit steps
+
+    def _takes_train_kwarg(self) -> bool:
+        if self._model_takes_train_kwarg is None:
+            try:
+                sig = inspect.signature(self._spec.model.__call__)
+                self._model_takes_train_kwarg = "train" in sig.parameters
+            except (TypeError, ValueError):  # pragma: no cover
+                self._model_takes_train_kwarg = False
+        return self._model_takes_train_kwarg
+
+    def _apply_model(self, variables, features, embeddings, train: bool):
+        model = self._spec.model
+        args = [features]
+        if self._emb_specs:
+            args.append(embeddings)
+        kwargs = {}
+        if self._takes_train_kwarg():
+            kwargs["train"] = train
+        aux_keys = [k for k in variables.keys() if k != "params"]
+        if train and aux_keys:
+            return model.apply(variables, *args, mutable=aux_keys, **kwargs)
+        return model.apply(variables, *args, **kwargs), None
+
+    def _init_model(self, features, embeddings):
+        model = self._spec.model
+        args = [features]
+        if self._emb_specs:
+            args.append(embeddings)
+        kwargs = {"train": False} if self._takes_train_kwarg() else {}
+        variables = model.init(self._rng, *args, **kwargs)
+        variables = jax.tree_util.tree_map(jnp.asarray, variables)
+        self._params = variables["params"]
+        self._aux = {k: v for k, v in variables.items() if k != "params"}
+
+    def _build_train_step(self):
+        spec = self._spec
+        has_emb = bool(self._emb_specs)
+
+        def step(params, aux, bets, bet_aux, features, labels):
+            def loss_fn(params, bets):
+                embeddings = (
+                    {
+                        k: EmbeddingInput(bets[k], bet_aux[k][0], bet_aux[k][1])
+                        for k in bets
+                    }
+                    if has_emb
+                    else None
+                )
+                variables = {"params": params, **aux}
+                outputs, new_aux = self._apply_model(
+                    variables, features, embeddings, train=True
+                )
+                return spec.loss(outputs, labels), new_aux
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_fn, argnums=(0, 1) if has_emb else 0, has_aux=True
+            )(params, bets)
+            if has_emb:
+                gparams, gbets = grads
+            else:
+                gparams, gbets = grads, {}
+            return loss, gparams, gbets, new_aux
+
+        jitted = self._shard_jit(step)
+
+        def run(params, aux, batch_embs: Dict[str, BatchEmbedding], features, labels):
+            bets = {k: b.bet for k, b in batch_embs.items()}
+            bet_aux = {k: (b.inverse, b.mask) for k, b in batch_embs.items()}
+            return jitted(params, aux, bets, bet_aux, features, labels)
+
+        return run
+
+    def _shard_jit(self, fn):
+        """jit with batch sharded over the local dp mesh (params/bets
+        replicated) — XLA inserts the gradient all-reduce across local
+        chips. Single-device hosts jit plain."""
+        mesh = self._mesh
+        if mesh is None or mesh.size <= 1:
+            return jax.jit(fn)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        batch = NamedSharding(mesh, P(mesh.axis_names[0]))
+        return jax.jit(
+            fn,
+            in_shardings=(repl, repl, repl, batch, batch, batch),
+            out_shardings=repl,
+        )
+
+    def _build_eval_step(self):
+        spec = self._spec
+        has_emb = bool(self._emb_specs)
+
+        def step(params, aux, bets, bet_aux, features, labels):
+            embeddings = (
+                {
+                    k: EmbeddingInput(bets[k], bet_aux[k][0], bet_aux[k][1])
+                    for k in bets
+                }
+                if has_emb
+                else None
+            )
+            variables = {"params": params, **aux}
+            outputs, _ = self._apply_model(variables, features, embeddings, train=False)
+            return outputs
+
+        jitted = self._shard_jit_eval(step)
+
+        def run(params, aux, batch_embs, features, labels):
+            bets = {k: b.bet for k, b in batch_embs.items()}
+            bet_aux = {k: (b.inverse, b.mask) for k, b in batch_embs.items()}
+            return jitted(params, aux, bets, bet_aux, features, labels)
+
+        return run
+
+    def _shard_jit_eval(self, fn):
+        mesh = self._mesh
+        if mesh is None or mesh.size <= 1:
+            return jax.jit(fn)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        batch = NamedSharding(mesh, P(mesh.axis_names[0]))
+        return jax.jit(
+            fn,
+            in_shardings=(repl, repl, repl, batch, batch, batch),
+            out_shardings=batch,
+        )
+
+    # --------------------------------------------------------- task handling
+
+    def _divisible(self, features) -> bool:
+        if self._mesh is None or self._mesh.size <= 1:
+            return True
+        n = len(jax.tree_util.tree_leaves(features)[0])
+        return n % self._mesh.size == 0
+
+    def _process_minibatch(self, features, labels, task: Task) -> float:
+        """Sync-SGD retry loop (reference: worker.py:347-388)."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+            self._eval_step = self._build_eval_step()
+
+        for _ in range(MAX_MINIBATCH_RETRY_NUM):
+            if not self.pull_model(max(self._version, task.model_version)):
+                # master uninitialized: init from our side (lazy PS init,
+                # reference worker.py:278-282, servicer.py:299-303)
+                embs = self._prepare_embeddings(features)
+                dev_embs = {k: b for k, b in embs.items()}
+                self._init_model(features, self._dev_embedding_inputs(dev_embs))
+                self.report_variable()
+                self.pull_model()
+            embs = self._prepare_embeddings(features)
+            step = self._train_step
+            if not self._divisible(features):
+                step = self._ragged_train_step()
+            loss, gparams, gbets, new_aux = step(
+                self._params, self._aux, embs, features, labels
+            )
+            edl_grads = {
+                name: extract_indexed_grads(
+                    self._emb_specs[name], np.asarray(gbets[name]), embs[name]
+                )
+                for name in gbets
+            }
+            resp = self.report_gradient(gparams, edl_grads, new_aux)
+            if resp["accepted"]:
+                return float(loss)
+        raise RuntimeError("worker stuck: minibatch retries exhausted")
+
+    def _ragged_train_step(self):
+        """Uncached single-device fallback for batches not divisible by
+        the local mesh (the final partial batch of a task)."""
+        if not hasattr(self, "_ragged_step"):
+            saved_mesh = self._mesh
+            self._mesh = None
+            self._ragged_step = self._build_train_step()
+            self._mesh = saved_mesh
+        return self._ragged_step
+
+    def _dev_embedding_inputs(self, embs: Dict[str, BatchEmbedding]):
+        return {
+            k: EmbeddingInput(b.bet, b.inverse, b.mask) for k, b in embs.items()
+        }
+
+    def _parse(self, chunk, mode):
+        feats, labels = self._spec.dataset_fn(chunk, mode)
+        return feats, labels
+
+    def _process_training_task(self, task: Task):
+        reader = self._readers.get(task.shard_file_name)
+        records = list(reader.read_range(task.start, task.end))
+        chunks = iter_minibatches(records, self._minibatch_size)
+        for features, labels in PrefetchParser(
+            chunks, lambda c: self._parse(c, Mode.TRAINING)
+        ):
+            loss = self._process_minibatch(features, labels, task)
+        logger.info(
+            "Worker %d task %d done (last loss %.4f, v%d)",
+            self._id,
+            task.task_id,
+            loss,
+            self._version,
+        )
+
+    def _process_evaluation_task(self, task: Task):
+        """Version-pinned eval (reference: worker.py:354-358, FIXED pull
+        served from the eval snapshot, servicer.py:128-139)."""
+        saved = (self._params, self._aux, self._version)
+        try:
+            self.pull_model(task.model_version, MethodType.FIXED)
+            if self._eval_step is None:
+                self._eval_step = self._build_eval_step()
+            reader = self._readers.get(task.shard_file_name)
+            records = list(reader.read_range(task.start, task.end))
+            for chunk in iter_minibatches(records, self._minibatch_size):
+                features, labels = self._parse(chunk, Mode.EVALUATION)
+                embs = self._prepare_embeddings(features)
+                step = (
+                    self._eval_step
+                    if self._divisible(features)
+                    else self._ragged_eval_step()
+                )
+                outputs = step(self._params, self._aux, embs, features, labels)
+                metrics = {
+                    k: float(v)
+                    for k, v in self._spec.eval_metrics_fn(
+                        outputs, jnp.asarray(labels)
+                    ).items()
+                }
+                n = len(jax.tree_util.tree_leaves(features)[0])
+                self._master.call(
+                    "ReportEvaluationMetrics",
+                    {
+                        "model_version": task.model_version,
+                        "metrics": metrics,
+                        "num_examples": n,
+                    },
+                )
+        finally:
+            self._params, self._aux, self._version = saved
+
+    def _ragged_eval_step(self):
+        if not hasattr(self, "_ragged_eval"):
+            saved_mesh = self._mesh
+            self._mesh = None
+            self._ragged_eval = self._build_eval_step()
+            self._mesh = saved_mesh
+        return self._ragged_eval
+
+    def _process_prediction_task(self, task: Task):
+        """reference: worker.py prediction path + BasePredictionOutputsProcessor
+        (worker/prediction_outputs_processor.py:4-22)."""
+        self.pull_model()
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        reader = self._readers.get(task.shard_file_name)
+        records = list(reader.read_range(task.start, task.end))
+        for chunk in iter_minibatches(records, self._minibatch_size):
+            features, _ = self._parse(chunk, Mode.PREDICTION)
+            embs = self._prepare_embeddings(features)
+            step = (
+                self._eval_step
+                if self._divisible(features)
+                else self._ragged_eval_step()
+            )
+            outputs = step(self._params, self._aux, embs, features, None)
+            proc = self._spec.prediction_outputs_processor
+            if proc is not None:
+                proc.process(np.asarray(outputs), self._id)
+
+    # ------------------------------------------------------------- main loop
+
+    def run(self):
+        """Task loop (reference: worker.py:432-463). Each task is pulled,
+        processed to completion, and reported; failures report the error
+        so the master requeues the shard."""
+        while True:
+            task, finished = self.get_task()
+            if task.type == TaskType.WAIT:
+                if finished:
+                    logger.info("Worker %d: job finished, exiting", self._id)
+                    return
+                time.sleep(0.2)
+                continue
+            err = ""
+            try:
+                if task.type == TaskType.TRAINING:
+                    self._process_training_task(task)
+                elif task.type == TaskType.EVALUATION:
+                    self._process_evaluation_task(task)
+                elif task.type == TaskType.PREDICTION:
+                    self._process_prediction_task(task)
+                else:
+                    err = f"unknown task type {task.type}"
+            except Exception as e:
+                logger.exception("Worker %d task %d failed", self._id, task.task_id)
+                err = f"{type(e).__name__}: {e}"
+            self.report_task_result(task.task_id, err)
+
+    def close(self):
+        self._readers.close()

@@ -10,9 +10,18 @@ following the reference's K8S_TESTS env-switch pattern
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't default: the shell env may carry JAX_PLATFORMS=axon (the
+# real TPU tunnel); unit tests must stay hermetic on the CPU backend.
+# TPU-gated tests re-enable the device via EDL_TPU_TESTS=1 themselves.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The image's sitecustomize force-registers the axon TPU platform even
+# over JAX_PLATFORMS=cpu; the config knob after import wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

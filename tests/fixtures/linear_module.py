@@ -1,0 +1,38 @@
+"""Minimal fixture model: linear regression on y = 2x + 1 records.
+
+Mirrors the reference's in-repo test model
+(elasticdl/python/tests/test_module.py) so unit tests don't depend on
+model_zoo/.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class Linear(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(1)(x)
+
+
+def custom_model():
+    return Linear()
+
+
+def dataset_fn(records, mode):
+    arr = np.stack([np.frombuffer(r, dtype=np.float32) for r in records])
+    return arr[:, :1], arr[:, 1:]
+
+
+def loss(outputs, labels):
+    return jnp.mean((outputs - labels) ** 2)
+
+
+def optimizer():
+    return optax.sgd(0.5)
+
+
+def eval_metrics_fn(predictions, labels):
+    return {"mse": jnp.mean((predictions - labels) ** 2)}

@@ -1,0 +1,112 @@
+"""Hermetic end-to-end training: real Worker + real MasterServicer +
+real TaskDispatcher + real RecordIO tempfiles, one process.
+
+Mirrors the reference's flagship worker_test.py (tests/worker_test.py:49-137),
+including the forced-gradient-rejection retry test (:73-86).
+"""
+
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.testing import InProcessMaster, write_linear_records
+from elasticdl_tpu.worker.worker import Worker
+
+from tests.fixtures import linear_module
+
+
+def make_job(tmp_path, n_records=64, records_per_task=16, epochs=2, grads_to_wait=1):
+    path = str(tmp_path / "train.rio")
+    write_linear_records(path, n_records, noise=0.05)
+    dispatcher = TaskDispatcher({path: n_records}, {}, {}, records_per_task, epochs)
+    # the PS owns the optimizer, built from the model-zoo spec exactly
+    # like the real master (reference: master/main.py:103-109)
+    servicer = MasterServicer(
+        grads_to_wait=grads_to_wait,
+        optimizer=PSOptimizer(linear_module.optimizer()),
+        task_dispatcher=dispatcher,
+    )
+    return dispatcher, servicer
+
+
+def test_single_worker_trains_to_convergence(tmp_path):
+    dispatcher, servicer = make_job(tmp_path, epochs=8)
+    master = InProcessMaster(servicer)
+    spec = spec_from_module(linear_module)
+    worker = Worker(0, master, spec, minibatch_size=16)
+    worker.run()
+
+    assert dispatcher.finished()
+    assert servicer.version > 0
+    params, _aux, _v = servicer.get_params_copy()
+    kernel = np.asarray(params["Dense_0"]["kernel"]).ravel()[0]
+    bias = np.asarray(params["Dense_0"]["bias"]).ravel()[0]
+    assert abs(kernel - 2.0) < 0.3
+    assert abs(bias - 1.0) < 0.3
+
+
+def test_gradient_rejection_retry_path(tmp_path):
+    """Every other gradient report is forced stale; training must still
+    complete via the retry loop (reference: worker_test.py:73-86)."""
+    dispatcher, servicer = make_job(tmp_path, epochs=2)
+
+    state = {"n": 0}
+
+    def make_stale(req):
+        state["n"] += 1
+        if state["n"] % 2 == 0:
+            req = dict(req)
+            req["version"] = req["version"] - 1  # pretend computed on old model
+        return req
+
+    master = InProcessMaster(servicer, intercept={"ReportGradient": make_stale})
+    spec = spec_from_module(linear_module)
+    worker = Worker(0, master, spec, minibatch_size=16)
+    worker.run()
+
+    assert dispatcher.finished()
+    # rejected reports forced retries: more ReportGradient calls than steps
+    assert master.calls["ReportGradient"] > servicer.version
+
+
+def test_two_workers_share_the_queue(tmp_path):
+    dispatcher, servicer = make_job(tmp_path, epochs=2, grads_to_wait=2)
+    master = InProcessMaster(servicer)
+    spec0 = spec_from_module(linear_module)
+    spec1 = spec_from_module(linear_module)
+    w0 = Worker(0, master, spec0, minibatch_size=16)
+    w1 = Worker(1, master, spec1, minibatch_size=16)
+
+    import threading
+
+    t0 = threading.Thread(target=w0.run)
+    t1 = threading.Thread(target=w1.run)
+    t0.start(), t1.start()
+    t0.join(120), t1.join(120)
+
+    assert dispatcher.finished()
+    assert servicer.version > 0
+
+
+def test_local_dp_mesh_matches_single_device(tmp_path):
+    """The same worker code with an 8-way local dp mesh must produce a
+    working training run (gradients pre-reduced by XLA across the mesh)."""
+    import jax
+
+    from elasticdl_tpu.parallel.mesh import local_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    dispatcher, servicer = make_job(tmp_path, epochs=4)
+    master = InProcessMaster(servicer)
+    spec = spec_from_module(linear_module)
+    worker = Worker(0, master, spec, minibatch_size=16, mesh=local_mesh(8))
+    worker.run()
+    assert dispatcher.finished()
+    params, _aux, _v = servicer.get_params_copy()
+    kernel = np.asarray(params["Dense_0"]["kernel"]).ravel()[0]
+    assert abs(kernel - 2.0) < 0.5
