@@ -77,10 +77,12 @@ def _dtype_from_str(s: str) -> np.dtype:
 
 
 def _encode_array(a: np.ndarray) -> dict:
+    a = np.asarray(a)
+    shape = list(a.shape)  # before ascontiguousarray: it promotes 0-d to 1-d
     a = np.ascontiguousarray(a)
     return {
         "d": _dtype_to_str(a.dtype),
-        "s": list(a.shape),
+        "s": shape,
         "b": a.tobytes(),
     }
 

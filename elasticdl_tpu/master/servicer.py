@@ -200,6 +200,9 @@ class MasterServicer:
         edl_grads: Dict[str, IndexedRows] = req.get("edl_gradient") or {}
         aux_state = req.get("aux_state")
 
+        applied = False
+        applied_version = -1
+        ckpt_snapshot = None
         with self._lock:
             if self._params is None:
                 raise ValueError("gradient reported before model init")
@@ -220,38 +223,56 @@ class MasterServicer:
                     # doc/async_sgd_design.md:75-82
                     scale = 1.0 / float(staleness)
                 self._apply(grads, edl_grads, dense_scale=scale, aux_state=aux_state)
-                return {"accepted": True, "version": self._version}
-
-            # sync accumulate
-            if self._grad_sum is None:
-                self._grad_sum = jax.tree_util.tree_map(
-                    lambda g: np.asarray(g, dtype=np.float32).copy(), grads
-                )
+                applied = True
             else:
-                self._grad_sum = jax.tree_util.tree_map(
-                    lambda s, g: s + np.asarray(g, dtype=np.float32),
-                    self._grad_sum,
-                    grads,
-                )
-            for layer, ir in edl_grads.items():
-                self._edl_grads.setdefault(layer, []).append(ir)
-            if aux_state is not None:
-                self._pending_aux = aux_state
-            self._grad_n += 1
-            if self._grad_n >= self._grads_to_wait:
-                avg = jax.tree_util.tree_map(
-                    lambda s: s / self._grad_n, self._grad_sum
-                )
-                merged = {
-                    layer: merge_indexed_rows(irs)
-                    for layer, irs in self._edl_grads.items()
-                }
-                self._apply(avg, merged, aux_state=self._pending_aux)
-                self._pending_aux = None
-                self._grad_sum = None
-                self._grad_n = 0
-                self._edl_grads = {}
-            return {"accepted": True, "version": self._version}
+                # sync accumulate
+                if self._grad_sum is None:
+                    self._grad_sum = jax.tree_util.tree_map(
+                        lambda g: np.asarray(g, dtype=np.float32).copy(), grads
+                    )
+                else:
+                    self._grad_sum = jax.tree_util.tree_map(
+                        lambda s, g: s + np.asarray(g, dtype=np.float32),
+                        self._grad_sum,
+                        grads,
+                    )
+                for layer, ir in edl_grads.items():
+                    self._edl_grads.setdefault(layer, []).append(ir)
+                if aux_state is not None:
+                    self._pending_aux = aux_state
+                self._grad_n += 1
+                if self._grad_n >= self._grads_to_wait:
+                    avg = jax.tree_util.tree_map(
+                        lambda s: s / self._grad_n, self._grad_sum
+                    )
+                    merged = {
+                        layer: merge_indexed_rows(irs)
+                        for layer, irs in self._edl_grads.items()
+                    }
+                    self._apply(avg, merged, aux_state=self._pending_aux)
+                    self._pending_aux = None
+                    self._grad_sum = None
+                    self._grad_n = 0
+                    self._edl_grads = {}
+                    applied = True
+            resp = {"accepted": True, "version": self._version}
+            if applied:
+                # snapshot the exact applied version UNDER the lock so a
+                # concurrent report can't skip a checkpoint/eval trigger;
+                # params are copied only when this version checkpoints
+                applied_version = self._version
+                if self._checkpoint_service and self._checkpoint_service.need_to_checkpoint(
+                    applied_version
+                ):
+                    ckpt_snapshot = (
+                        jax.tree_util.tree_map(np.copy, self._params),
+                        jax.tree_util.tree_map(np.copy, self._aux),
+                    )
+        if applied:
+            # hooks run OUTSIDE the lock: the eval service calls back
+            # into get_params_copy and must not deadlock
+            self._on_version_bump(applied_version, ckpt_snapshot)
+        return resp
 
     def _validate(self, grads):
         """Shape sanity checks (reference: servicer.py:320-370)."""
@@ -284,15 +305,23 @@ class MasterServicer:
                 )
             self._params = self._opt.step(self._params, dense_grads)
         self._version += 1
-        self._on_version_bump()
 
-    def _on_version_bump(self):
-        if self._checkpoint_service and self._checkpoint_service.need_to_checkpoint(
-            self._version
-        ):
-            self._checkpoint_service.save(self._params, self._version, aux=self._aux)
+    def _on_version_bump(self, version: int, ckpt_snapshot=None):
+        """Checkpoint/eval hooks for an applied version. Caller must NOT
+        hold the lock (reference fires these inside its mutex,
+        servicer.py:269-280; here the eval hook re-enters
+        get_params_copy). `ckpt_snapshot` was taken under the lock at
+        exactly `version`."""
+        if ckpt_snapshot is not None and self._checkpoint_service:
+            params, aux = ckpt_snapshot
+            self._checkpoint_service.save(params, version, aux=aux)
         if self._evaluation_service:
-            self._evaluation_service.add_evaluation_task_if_needed(self._version)
+            self._evaluation_service.add_evaluation_task_if_needed(version)
+
+    def set_evaluation_service(self, evaluation_service):
+        """Late wiring: the eval service needs the servicer's model
+        getter and the servicer needs the eval service's hooks."""
+        self._evaluation_service = evaluation_service
 
     # -- RPC: evaluation -----------------------------------------------------
 

@@ -33,8 +33,16 @@ class TaskDispatcher:
         prediction_shards: Dict[str, int],
         records_per_task: int,
         num_epochs: int,
+        max_task_retries: int = 10,
     ):
         self._lock = threading.Lock()
+        # Unlike the reference (which requeues failed tasks forever,
+        # task_dispatcher.py:153-176), cap per-task retries so a poison
+        # task (bad record / model bug) fails the shard loudly instead
+        # of livelocking the job.
+        self._max_task_retries = max_task_retries
+        self._retry_count: Dict[int, int] = {}
+        self.failed_tasks: list[Task] = []
         self._training_shards = training_shards
         self._evaluation_shards = evaluation_shards
         self._prediction_shards = prediction_shards
@@ -128,8 +136,26 @@ class TaskDispatcher:
                 return False
             _, task = worker_and_task
             if not success:
-                logger.warning("Task %d failed, requeueing", task_id)
-                self._todo.append(task)
+                n = self._retry_count.get(task_id, 0) + 1
+                self._retry_count[task_id] = n
+                if n >= self._max_task_retries:
+                    logger.error(
+                        "Task %d failed %d times, dropping (poison task)",
+                        task_id,
+                        n,
+                    )
+                    self.failed_tasks.append(task)
+                    # a dropped EVALUATION task still counts toward the
+                    # eval job's completion, else has_pending() wedges
+                    # every worker in WAIT forever
+                    if (
+                        task.type == TaskType.EVALUATION
+                        and self._evaluation_service is not None
+                    ):
+                        evaluation_task_completed = task
+                else:
+                    logger.warning("Task %d failed, requeueing", task_id)
+                    self._todo.append(task)
             elif (
                 task.type == TaskType.EVALUATION
                 and self._evaluation_service is not None
@@ -141,17 +167,32 @@ class TaskDispatcher:
 
     def recover_tasks(self, worker_id: int):
         """Requeue every in-flight task of a dead worker
-        (reference :182-190) — invoked from the pod-event callback."""
+        (reference :182-190) — invoked from the pod-event callback.
+
+        Does NOT touch the poison-task retry counter: worker preemption
+        is the framework's normal elasticity event, and a healthy task
+        that keeps landing on dying workers must never be classified as
+        poison."""
         with self._lock:
-            ids = [
+            for tid in [
                 tid for tid, (wid, _) in self._doing.items() if wid == worker_id
-            ]
-        for tid in ids:
-            self.report(tid, False)
+            ]:
+                _, task = self._doing.pop(tid)
+                logger.info("Recovering task %d from dead worker %d", tid, worker_id)
+                self._todo.append(task)
 
     def finished(self) -> bool:
-        """All epochs exhausted and nothing in flight (reference :178-180)."""
+        """All epochs exhausted and nothing in flight (reference :178-180).
+        True even when tasks were dropped as poison — the job *ends*;
+        callers must check `has_failed_tasks()` to decide success."""
         with self._lock:
             if self._training_shards and self._epoch < self._num_epochs - 1:
                 return False
             return not self._todo and not self._doing
+
+    def has_failed_tasks(self) -> bool:
+        """True when any task was dropped after exhausting its retries —
+        the job completed over partial data and must be reported as
+        failed by the master exit path."""
+        with self._lock:
+            return bool(self.failed_tasks)

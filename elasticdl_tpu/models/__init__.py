@@ -1,0 +1,21 @@
+"""Model zoo: the reference workloads rebuilt as flax modules.
+
+Mirrors the reference `model_zoo/` inventory (SURVEY §2.8, contract in
+elasticdl/doc/model_building.md:5-160). Each module exports the
+model-zoo contract consumed by `elasticdl_tpu.api.model_spec`:
+``custom_model``, ``dataset_fn``, ``loss``, ``optimizer``,
+``eval_metrics_fn`` (+ optional ``embedding_specs``,
+``sparse_optimizer``, ``PredictionOutputsProcessor``).
+
+| package | reference |
+|---|---|
+| mnist_functional_api | model_zoo/mnist_functional_api/mnist_functional_api.py |
+| mnist_subclass | model_zoo/mnist_subclass/mnist_subclass.py |
+| cifar10_functional_api | model_zoo/cifar10_functional_api/cifar10_functional_api.py |
+| cifar10_subclass | model_zoo/cifar10_subclass/cifar10_subclass.py |
+| resnet50_subclass | model_zoo/resnet50_subclass/resnet50_subclass.py |
+| imagenet_resnet50 | model_zoo/imagenet_resnet50/imagenet_resnet50.py |
+| deepfm_functional_api | model_zoo/deepfm_functional_api/deepfm_functional_api.py |
+| deepfm_edl_embedding | model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py |
+| transformer_lm | (new TPU-native flagship; no reference equivalent) |
+"""

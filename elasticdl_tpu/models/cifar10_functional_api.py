@@ -1,0 +1,65 @@
+"""CIFAR-10 VGG-style conv-net with BatchNorm, functional style.
+
+Reference: model_zoo/cifar10_functional_api/cifar10_functional_api.py
+(:1-190, the perf-test subject of
+elasticdl/doc/worker_optimization_design.md:33-46). BatchNorm exercises
+the non-trainable `batch_stats` collection flowing PS-ward as aux state
+(servicer `_apply` last-writer-wins).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.models.record_codec import decode_image_records
+
+IMAGE_SHAPE = (32, 32, 3)
+NUM_CLASSES = 10
+
+
+class VGGBlock(nn.Module):
+    features: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for _ in range(2):
+            x = nn.Conv(self.features, (3, 3), use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            x = nn.relu(x)
+        return nn.max_pool(x, (2, 2), strides=(2, 2))
+
+
+class Cifar10Model(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for feats in (32, 64, 128):
+            x = VGGBlock(feats)(x, train=train)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(256)(x))
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+def custom_model():
+    return Cifar10Model()
+
+
+def dataset_fn(records, mode):
+    return decode_image_records(records, IMAGE_SHAPE)
+
+
+def loss(outputs, labels):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(outputs, labels)
+    )
+
+
+def optimizer():
+    return optax.sgd(0.1, momentum=0.9)
+
+
+def eval_metrics_fn(predictions, labels):
+    return {
+        "accuracy": jnp.mean(
+            (jnp.argmax(predictions, axis=-1) == labels).astype(jnp.float32)
+        )
+    }

@@ -1,0 +1,57 @@
+"""CIFAR-10 conv-net, module-subclass style.
+
+Reference: model_zoo/cifar10_subclass/cifar10_subclass.py (:1-176).
+Same topology as the functional variant, explicit `setup`.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.models.record_codec import decode_image_records
+
+IMAGE_SHAPE = (32, 32, 3)
+NUM_CLASSES = 10
+
+
+class Cifar10Subclass(nn.Module):
+    def setup(self):
+        self.convs = [nn.Conv(f, (3, 3), use_bias=False) for f in (32, 32, 64, 64, 128, 128)]
+        self.bns = [nn.BatchNorm(use_running_average=None) for _ in range(6)]
+        self.dense1 = nn.Dense(256)
+        self.dense2 = nn.Dense(NUM_CLASSES)
+
+    def __call__(self, x, train: bool = False):
+        for i, (conv, bn) in enumerate(zip(self.convs, self.bns)):
+            x = nn.relu(bn(conv(x), use_running_average=not train))
+            if i % 2 == 1:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(self.dense1(x))
+        return self.dense2(x)
+
+
+def custom_model():
+    return Cifar10Subclass()
+
+
+def dataset_fn(records, mode):
+    return decode_image_records(records, IMAGE_SHAPE)
+
+
+def loss(outputs, labels):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(outputs, labels)
+    )
+
+
+def optimizer():
+    return optax.sgd(0.1, momentum=0.9)
+
+
+def eval_metrics_fn(predictions, labels):
+    return {
+        "accuracy": jnp.mean(
+            (jnp.argmax(predictions, axis=-1) == labels).astype(jnp.float32)
+        )
+    }

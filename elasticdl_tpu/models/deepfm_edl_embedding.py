@@ -1,0 +1,74 @@
+"""DeepFM with PS-resident elastic embedding tables.
+
+Reference: model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py
+(:27-60) — the ElasticDL-Embedding variant (unbounded vocab in the KV
+store, mask_zero, AUC metric) exercising the full sparse path:
+host-side BET fetch with lazy init -> jitted forward via
+`embedding_forward` -> per-row gradients shipped as IndexedRows ->
+`SparseOptimizer` rows+slots update on the PS.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.api.layers import EmbeddingSpec, embedding_forward
+from elasticdl_tpu.models.deepfm_functional_api import _auc
+from elasticdl_tpu.models.record_codec import decode_tabular_records
+
+NUM_FIELDS = 10
+EMB_DIM = 8
+
+# no vocab size anywhere: the tables grow with the ids that arrive
+# (reference layers/embedding.py has no input_dim)
+embedding_specs = [
+    EmbeddingSpec(name="fm_second", dim=EMB_DIM, input_key="ids", mask_zero=True),
+    EmbeddingSpec(name="fm_first", dim=1, input_key="ids", mask_zero=True),
+]
+
+sparse_optimizer = {"kind": "adam", "learning_rate": 1e-3}
+
+
+class DeepFMEdl(nn.Module):
+    @nn.compact
+    def __call__(self, features, embeddings):
+        e2 = embeddings["fm_second"]
+        e1 = embeddings["fm_first"]
+        v = embedding_forward(e2.bet, e2.inverse, e2.mask)  # [B,F,K]
+        first = embedding_forward(e1.bet, e1.inverse, e1.mask, combiner="sum")[
+            :, 0
+        ]  # [B]
+        s = jnp.sum(v, axis=1)
+        second = 0.5 * jnp.sum(s * s - jnp.sum(v * v, axis=1), axis=-1)
+        h = v.reshape((v.shape[0], -1))
+        h = nn.relu(nn.Dense(64)(h))
+        h = nn.relu(nn.Dense(32)(h))
+        deep = nn.Dense(1)(h)[:, 0]
+        bias = self.param("bias", nn.initializers.zeros, ())
+        return first + second + deep + bias
+
+
+def custom_model():
+    return DeepFMEdl()
+
+
+def dataset_fn(records, mode):
+    ids, labels = decode_tabular_records(records, NUM_FIELDS)
+    return {"ids": ids.astype("int32")}, labels
+
+
+def loss(outputs, labels):
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(outputs, labels))
+
+
+def optimizer():
+    return optax.adam(1e-3)
+
+
+def eval_metrics_fn(predictions, labels):
+    return {
+        "accuracy": jnp.mean(
+            ((predictions > 0) == (labels > 0.5)).astype(jnp.float32)
+        ),
+        "auc": _auc(predictions, labels),
+    }

@@ -1,0 +1,43 @@
+"""ImageNet → RecordIO data-prep contract for ResNet-50.
+
+Reference: model_zoo/imagenet_resnet50/imagenet_resnet50.py:4-26 — the
+`prepare_data_for_a_single_file(file_object, filename)` hook consumed
+by the PySpark conversion driver
+(elasticdl/python/data/recordio_gen/sample_pyspark_recordio_gen/
+spark_gen_recordio.py:14-30; contract documented in
+elasticdl/doc/model_building.md:163-196).
+
+The reference decodes JPEG tarballs via TF ops; this rebuild is TF-free
+and accepts tar members that are `.npy` arrays (HWC uint8) whose member
+name encodes the label as its leading path component
+(`<label>/<anything>.npy`). Returns a list of encoded records ready for
+a RecordIO writer.
+"""
+
+import io
+import tarfile
+
+import numpy as np
+
+from elasticdl_tpu.models.record_codec import encode_image_record
+from elasticdl_tpu.models.resnet50_subclass import (  # noqa: F401 (model reuse)
+    custom_model,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+    optimizer,
+)
+
+
+def prepare_data_for_a_single_file(file_object, filename: str):
+    """One input tar -> list of encoded image records."""
+    records = []
+    with tarfile.open(fileobj=file_object, mode="r:*") as tar:
+        for member in tar.getmembers():
+            if not member.isfile() or not member.name.endswith(".npy"):
+                continue
+            label = int(member.name.split("/", 1)[0])
+            buf = tar.extractfile(member).read()
+            image = np.load(io.BytesIO(buf))
+            records.append(encode_image_record(image.astype(np.uint8), label))
+    return records

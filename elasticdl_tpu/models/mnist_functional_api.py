@@ -1,0 +1,67 @@
+"""MNIST conv-net, functional-composition style.
+
+Reference: model_zoo/mnist_functional_api/mnist_functional_api.py:8-96
+(the CI workhorse, scripts/client_test.sh:6-26). The Keras
+functional-vs-subclass duality collapses in flax; this variant keeps
+the "functional" flavor by composing a `nn.Sequential`.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.models.record_codec import decode_image_records
+
+IMAGE_SHAPE = (28, 28, 1)
+NUM_CLASSES = 10
+
+
+def custom_model():
+    return nn.Sequential(
+        [
+            nn.Conv(32, (3, 3)),
+            nn.relu,
+            nn.Conv(64, (3, 3)),
+            nn.relu,
+            lambda x: nn.max_pool(x, (2, 2), strides=(2, 2)),
+            lambda x: x.reshape((x.shape[0], -1)),
+            nn.Dense(128),
+            nn.relu,
+            nn.Dense(NUM_CLASSES),
+        ]
+    )
+
+
+def dataset_fn(records, mode):
+    images, labels = decode_image_records(records, IMAGE_SHAPE)
+    return images, labels
+
+
+def loss(outputs, labels):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(outputs, labels)
+    )
+
+
+def optimizer():
+    return optax.sgd(0.1, momentum=0.9)
+
+
+def eval_metrics_fn(predictions, labels):
+    return {
+        "accuracy": jnp.mean(
+            (jnp.argmax(predictions, axis=-1) == labels).astype(jnp.float32)
+        )
+    }
+
+
+class PredictionOutputsProcessor:
+    """Sink for prediction outputs
+    (reference: worker/prediction_outputs_processor.py:4-22)."""
+
+    def __init__(self):
+        self.outputs = []
+
+    def process(self, predictions, worker_id):
+        self.outputs.append((worker_id, np.argmax(predictions, axis=-1)))

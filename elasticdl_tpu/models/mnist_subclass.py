@@ -1,0 +1,56 @@
+"""MNIST conv-net, module-subclass style.
+
+Reference: model_zoo/mnist_subclass/mnist_subclass.py (same math as the
+functional variant; exercises the explicit-`setup` module style).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.models.record_codec import decode_image_records
+
+IMAGE_SHAPE = (28, 28, 1)
+NUM_CLASSES = 10
+
+
+class MnistModel(nn.Module):
+    def setup(self):
+        self.conv1 = nn.Conv(32, (3, 3))
+        self.conv2 = nn.Conv(64, (3, 3))
+        self.dense1 = nn.Dense(128)
+        self.dense2 = nn.Dense(NUM_CLASSES)
+
+    def __call__(self, x):
+        x = nn.relu(self.conv1(x))
+        x = nn.relu(self.conv2(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(self.dense1(x))
+        return self.dense2(x)
+
+
+def custom_model():
+    return MnistModel()
+
+
+def dataset_fn(records, mode):
+    return decode_image_records(records, IMAGE_SHAPE)
+
+
+def loss(outputs, labels):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(outputs, labels)
+    )
+
+
+def optimizer():
+    return optax.sgd(0.1, momentum=0.9)
+
+
+def eval_metrics_fn(predictions, labels):
+    return {
+        "accuracy": jnp.mean(
+            (jnp.argmax(predictions, axis=-1) == labels).astype(jnp.float32)
+        )
+    }

@@ -1,0 +1,119 @@
+"""Record payload codecs shared by the model zoo.
+
+The reference serializes `tf.train.Example` protos into RecordIO
+(elasticdl/python/data/recordio_gen/image_label.py:12-58,
+frappe_recordio_gen.py). TF-free rebuild: fixed-layout numpy byte
+records — an int64 label header followed by the raw feature bytes.
+Vectorized decode (one `np.frombuffer` per record, one `np.stack` per
+batch) keeps the host input path off the critical step time.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# ----------------------------------------------------------- image records
+# layout: int64 label | uint8[prod(shape)] pixels
+
+
+def encode_image_record(image: np.ndarray, label: int) -> bytes:
+    image = np.ascontiguousarray(image, dtype=np.uint8)
+    return np.int64(label).tobytes() + image.tobytes()
+
+
+def decode_image_records(
+    records: Sequence[bytes], shape: Tuple[int, ...]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """-> (images float32 [B,*shape] scaled to [0,1], labels int64 [B])."""
+    labels = np.empty(len(records), dtype=np.int64)
+    images = np.empty((len(records),) + tuple(shape), dtype=np.float32)
+    for i, r in enumerate(records):
+        labels[i] = np.frombuffer(r, dtype=np.int64, count=1)[0]
+        images[i] = (
+            np.frombuffer(r, dtype=np.uint8, offset=8)
+            .reshape(shape)
+            .astype(np.float32)
+        )
+    images /= 255.0
+    return images, labels
+
+
+# --------------------------------------------------------- tabular records
+# layout: int64[num_fields] ids | float32 label
+# (frappe-style categorical rows, reference frappe_recordio_gen.py)
+
+
+def encode_tabular_record(ids: np.ndarray, label: float) -> bytes:
+    ids = np.ascontiguousarray(ids, dtype=np.int64)
+    return ids.tobytes() + np.float32(label).tobytes()
+
+
+def decode_tabular_records(
+    records: Sequence[bytes], num_fields: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """-> (ids int64 [B, num_fields], labels float32 [B])."""
+    ids = np.empty((len(records), num_fields), dtype=np.int64)
+    labels = np.empty(len(records), dtype=np.float32)
+    for i, r in enumerate(records):
+        ids[i] = np.frombuffer(r, dtype=np.int64, count=num_fields)
+        labels[i] = np.frombuffer(r, dtype=np.float32, offset=8 * num_fields)[0]
+    return ids, labels
+
+
+# ----------------------------------------------------------- token records
+# layout: int32[seq_len + 1] token ids (LM input is [:-1], target [1:])
+
+
+def encode_token_record(tokens: np.ndarray) -> bytes:
+    return np.ascontiguousarray(tokens, dtype=np.int32).tobytes()
+
+
+def decode_token_records(records: Sequence[bytes]) -> np.ndarray:
+    return np.stack([np.frombuffer(r, dtype=np.int32) for r in records])
+
+
+# ---------------------------------------------------- synthetic generators
+# (testdata writers, mirroring tests/worker_test.py:49-63's tempfile flow)
+
+
+def write_synthetic_image_records(
+    path: str, n: int, shape: Tuple[int, ...], num_classes: int, seed: int = 0
+):
+    from elasticdl_tpu.data.recordio import RecordIOWriter
+
+    rng = np.random.default_rng(seed)
+    with RecordIOWriter(path) as w:
+        for _ in range(n):
+            label = int(rng.integers(num_classes))
+            # class-dependent mean so tiny models can actually learn
+            img = np.clip(
+                rng.normal(40.0 + 15.0 * label, 25.0, size=shape), 0, 255
+            ).astype(np.uint8)
+            w.write(encode_image_record(img, label))
+
+
+def write_synthetic_tabular_records(
+    path: str, n: int, num_fields: int, vocab: int, seed: int = 0
+):
+    rng = np.random.default_rng(seed)
+    from elasticdl_tpu.data.recordio import RecordIOWriter
+
+    with RecordIOWriter(path) as w:
+        for _ in range(n):
+            ids = rng.integers(1, vocab, size=num_fields)
+            label = float(ids.sum() % 2)  # learnable parity-ish target
+            w.write(encode_tabular_record(ids, label))
+
+
+def write_synthetic_token_records(
+    path: str, n: int, seq_len: int, vocab: int, seed: int = 0
+):
+    rng = np.random.default_rng(seed)
+    from elasticdl_tpu.data.recordio import RecordIOWriter
+
+    with RecordIOWriter(path) as w:
+        for _ in range(n):
+            toks = rng.integers(0, vocab, size=seq_len + 1)
+            w.write(encode_token_record(toks))

@@ -1,0 +1,119 @@
+"""ResNet-50 — the north-star model (BASELINE.md).
+
+Reference: model_zoo/resnet50_subclass/resnet50_subclass.py (+
+resnet50_model.py): bottleneck Identity/Conv blocks, L2 regularization,
+BatchNorm constants. TPU-first notes:
+
+- NHWC layout and 3x3/1x1 convs map straight onto the MXU; compute can
+  run bfloat16 (`compute_dtype`) with float32 params/BN stats — the
+  standard TPU mixed-precision recipe;
+- BatchNorm stats ride the aux/batch_stats collection to the PS;
+- L2 is applied as decoupled weight decay in the optimizer (optax)
+  rather than per-layer kernel_regularizer terms.
+"""
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.models.record_codec import decode_image_records
+
+IMAGE_SHAPE = (64, 64, 3)  # synthetic/test default; ImageNet uses 224
+NUM_CLASSES = 10
+
+BN_MOMENTUM = 0.9  # reference resnet50_model.py BATCH_NORM_DECAY
+BN_EPSILON = 1e-5
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck; projection shortcut when shapes
+    change (reference resnet50_model.py Identity/Conv blocks)."""
+
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
+        bn = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPSILON,
+            dtype=self.compute_dtype,
+        )
+        residual = x
+        y = nn.relu(bn()(conv(self.features, (1, 1))(x)))
+        y = nn.relu(bn()(conv(self.features, (3, 3), strides=self.strides)(y)))
+        y = bn(scale_init=nn.initializers.zeros)(
+            conv(self.features * 4, (1, 1))(y)
+        )
+        if residual.shape[-1] != self.features * 4 or self.strides != (1, 1):
+            residual = bn()(
+                conv(self.features * 4, (1, 1), strides=self.strides)(residual)
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = NUM_CLASSES
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    compute_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False, dtype=self.compute_dtype)(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPSILON,
+            dtype=self.compute_dtype,
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            features = 64 * (2**i)
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = Bottleneck(
+                    features, strides, compute_dtype=self.compute_dtype
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model(num_classes: int = NUM_CLASSES, bfloat16: bool = False):
+    return ResNet50(
+        num_classes=num_classes,
+        compute_dtype=jnp.bfloat16 if bfloat16 else jnp.float32,
+    )
+
+
+def dataset_fn(records, mode):
+    return decode_image_records(records, IMAGE_SHAPE)
+
+
+def loss(outputs, labels):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(outputs, labels)
+    )
+
+
+def optimizer():
+    # decoupled weight decay stands in for the reference's per-kernel L2
+    return optax.chain(
+        optax.add_decayed_weights(1e-4), optax.sgd(0.1, momentum=0.9)
+    )
+
+
+def eval_metrics_fn(predictions, labels):
+    return {
+        "accuracy": jnp.mean(
+            (jnp.argmax(predictions, axis=-1) == labels).astype(jnp.float32)
+        )
+    }

@@ -38,6 +38,62 @@ class InProcessMaster:
         return messages.unpack(messages.pack(resp))
 
 
+def build_job(
+    spec,
+    dispatcher,
+    grads_to_wait: int = 1,
+    eval_steps: int = 0,
+    checkpoint_dir: str = "",
+    checkpoint_steps: int = 0,
+    keep_checkpoint_max: int = 0,
+    use_async: bool = False,
+    staleness_window: int = 0,
+):
+    """Wire a MasterServicer + services from a ModelSpec, exactly like
+    the real master boot (reference: master/main.py:138-223). Returns
+    (servicer, evaluation_service, checkpoint_service)."""
+    from elasticdl_tpu.master.checkpoint import CheckpointService
+    from elasticdl_tpu.master.embedding_store import EmbeddingStore
+    from elasticdl_tpu.master.evaluation_service import EvaluationService
+    from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.sparse_optimizer import SparseOptimizer
+
+    store = sparse_opt = None
+    if spec.embedding_specs:
+        store = EmbeddingStore()
+        sparse_opt = SparseOptimizer(store, **(spec.sparse_optimizer or {}))
+
+    ckpt = CheckpointService(
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_steps=checkpoint_steps,
+        keep_checkpoint_max=keep_checkpoint_max,
+        include_evaluation=bool(eval_steps),
+        embedding_store=store,
+    )
+    servicer = MasterServicer(
+        grads_to_wait=grads_to_wait,
+        optimizer=PSOptimizer(spec.optimizer()),
+        task_dispatcher=dispatcher,
+        checkpoint_service=ckpt,
+        embedding_store=store,
+        sparse_optimizer=sparse_opt,
+        use_async=use_async,
+        staleness_window=staleness_window,
+    )
+    eval_service = None
+    if eval_steps:
+        eval_service = EvaluationService(
+            ckpt,
+            dispatcher,
+            eval_steps=eval_steps,
+            current_model_fn=servicer.get_params_copy,
+        )
+        dispatcher.set_evaluation_service(eval_service)
+        servicer.set_evaluation_service(eval_service)
+    return servicer, eval_service, ckpt
+
+
 def write_linear_records(path: str, n: int, seed: int = 0, noise: float = 0.0):
     """y = 2x + 1 synthetic records (reference fixture:
     elasticdl/python/tests/test_module.py)."""

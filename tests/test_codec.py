@@ -65,3 +65,15 @@ def test_jax_array_encodes():
     a = jnp.ones((2, 2))
     out = codec.loads(codec.dumps({"a": a}))["a"]
     np.testing.assert_array_equal(out, np.ones((2, 2)))
+
+
+def test_zero_dim_arrays_round_trip():
+    """Regression: np.ascontiguousarray promotes 0-d to 1-d; scalar
+    params (e.g. a model's global bias) must keep shape ()."""
+    import numpy as np
+
+    from elasticdl_tpu.common import codec
+
+    out = codec.loads(codec.dumps({"bias": np.asarray(np.float32(3.5))}))
+    assert out["bias"].shape == ()
+    assert float(out["bias"]) == 3.5

@@ -1,0 +1,179 @@
+"""Model-zoo regression gate: every zoo package runs a complete
+hermetic job through the InProcessMaster harness.
+
+Mirrors the reference's example_test.py (280 LoC) — generated record
+files in tempdirs, real Worker + MasterServicer + TaskDispatcher per
+model (SURVEY §4.1).
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.models import record_codec as rc
+from elasticdl_tpu.models import (
+    cifar10_functional_api,
+    cifar10_subclass,
+    deepfm_edl_embedding,
+    deepfm_functional_api,
+    mnist_functional_api,
+    mnist_subclass,
+    resnet50_subclass,
+)
+from elasticdl_tpu.testing import InProcessMaster, build_job
+from elasticdl_tpu.worker.worker import Worker
+
+
+def _image_writer(shape, classes=10):
+    def write(path, n):
+        rc.write_synthetic_image_records(path, n, shape, classes)
+
+    return write
+
+
+def _tabular_writer(path, n):
+    rc.write_synthetic_tabular_records(
+        path, n, deepfm_functional_api.NUM_FIELDS, 200
+    )
+
+
+def run_training_job(
+    module,
+    writer,
+    tmp_path,
+    n_records=16,
+    records_per_task=8,
+    minibatch=8,
+    epochs=1,
+    eval_steps=0,
+):
+    train = str(tmp_path / "train.rio")
+    writer(train, n_records)
+    eval_shards = {}
+    if eval_steps:
+        ev = str(tmp_path / "eval.rio")
+        writer(ev, n_records // 2)
+        eval_shards = {ev: n_records // 2}
+    dispatcher = TaskDispatcher(
+        {train: n_records}, eval_shards, {}, records_per_task, epochs
+    )
+    spec = spec_from_module(module)
+    servicer, eval_service, ckpt = build_job(
+        spec, dispatcher, eval_steps=eval_steps
+    )
+    worker = Worker(0, InProcessMaster(servicer), spec, minibatch_size=minibatch)
+    worker.run()
+    assert dispatcher.finished()
+    assert servicer.version > 0
+    return servicer, eval_service
+
+
+@pytest.mark.parametrize(
+    "module",
+    [mnist_functional_api, mnist_subclass],
+    ids=["functional", "subclass"],
+)
+def test_mnist(module, tmp_path):
+    run_training_job(module, _image_writer((28, 28, 1)), tmp_path)
+
+
+@pytest.mark.parametrize(
+    "module",
+    [cifar10_functional_api, cifar10_subclass],
+    ids=["functional", "subclass"],
+)
+def test_cifar10_with_batchnorm_aux(module, tmp_path):
+    servicer, _ = run_training_job(module, _image_writer((32, 32, 3)), tmp_path)
+    # BN moving stats must have reached the PS as aux state
+    _params, aux, _v = servicer.get_params_copy()
+    assert aux and "batch_stats" in aux
+
+
+def test_resnet50(tmp_path):
+    run_training_job(
+        resnet50_subclass,
+        _image_writer(resnet50_subclass.IMAGE_SHAPE),
+        tmp_path,
+        n_records=4,
+        records_per_task=4,
+        minibatch=2,
+    )
+
+
+def test_mnist_training_with_evaluation(tmp_path):
+    _, eval_service = run_training_job(
+        mnist_functional_api,
+        _image_writer((28, 28, 1)),
+        tmp_path,
+        epochs=2,
+        eval_steps=2,
+    )
+    assert eval_service.completed_metrics
+    _version, metrics = eval_service.completed_metrics[0]
+    assert "accuracy" in metrics
+
+
+def test_deepfm_dense_table(tmp_path):
+    run_training_job(deepfm_functional_api, _tabular_writer, tmp_path)
+
+
+def test_deepfm_edl_embedding_sparse_path(tmp_path):
+    servicer, _ = run_training_job(deepfm_edl_embedding, _tabular_writer, tmp_path)
+    # PS tables must hold rows + adam slots for both layers
+    store = servicer._embedding_store
+    snap = store.snapshot()
+    assert "fm_second" in snap and "fm_first" in snap
+    assert "fm_second/slot/m" in snap and "fm_second/slot/v" in snap
+    # mask_zero: padding id 0 must never have learned a row
+    assert 0 not in snap["fm_second"]
+
+
+def test_prediction_job(tmp_path):
+    """train -> predict with the trained params, exercising the
+    prediction task type + PredictionOutputsProcessor sink."""
+    servicer, _ = run_training_job(
+        mnist_functional_api, _image_writer((28, 28, 1)), tmp_path
+    )
+    params, aux, version = servicer.get_params_copy()
+
+    pred = str(tmp_path / "pred.rio")
+    rc.write_synthetic_image_records(pred, 8, (28, 28, 1), 10)
+    dispatcher = TaskDispatcher({}, {}, {pred: 8}, 8, 1)
+    spec = spec_from_module(mnist_functional_api)
+    servicer2, _, _ = build_job(spec, dispatcher)
+    servicer2._params = params
+    servicer2._aux = aux
+    servicer2._version = version
+    worker = Worker(0, InProcessMaster(servicer2), spec, minibatch_size=8)
+    worker.run()
+    assert dispatcher.finished()
+    proc = spec.prediction_outputs_processor
+    assert proc.outputs and proc.outputs[0][1].shape == (8,)
+
+
+def test_imagenet_prepare_data(tmp_path):
+    """Data-prep contract (reference model_zoo/imagenet_resnet50): tar of
+    .npy arrays -> encoded records."""
+    import io
+    import tarfile
+
+    from elasticdl_tpu.models import imagenet_resnet50
+
+    buf = io.BytesIO()
+    rng = np.random.default_rng(0)
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        for label in (0, 1):
+            img = rng.integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+            data = io.BytesIO()
+            np.save(data, img)
+            raw = data.getvalue()
+            info = tarfile.TarInfo(f"{label}/img.npy")
+            info.size = len(raw)
+            tar.addfile(info, io.BytesIO(raw))
+    buf.seek(0)
+    records = imagenet_resnet50.prepare_data_for_a_single_file(buf, "x.tar")
+    assert len(records) == 2
+    images, labels = rc.decode_image_records(records, (8, 8, 3))
+    assert images.shape == (2, 8, 8, 3)
+    assert list(labels) == [0, 1]
