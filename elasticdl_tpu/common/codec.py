@@ -122,6 +122,46 @@ def _object_hook(m: dict) -> Any:
     return m
 
 
+def all_float_leaves(tree) -> bool:
+    import jax
+
+    return all(
+        np.issubdtype(np.asarray(leaf).dtype, np.floating)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def ravel_np(tree) -> np.ndarray:
+    """Concatenate a float pytree into ONE contiguous float32 vector
+    (tree_flatten order). TPU-first transport: the full model/gradient
+    rides a single buffer — one host<->device transfer and one memcpy
+    instead of one per leaf, which matters enormously when the device
+    is reached through a network tunnel."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return np.concatenate(
+        [np.asarray(leaf, dtype=np.float32).ravel() for leaf in leaves]
+    )
+
+
+def unravel_np(vec: np.ndarray, template) -> Any:
+    """Inverse of ravel_np given a template tree with the same
+    structure/shapes (e.g. the PS's param tree)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    vec = np.asarray(vec, dtype=np.float32)
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(np.prod(np.asarray(leaf).shape, dtype=np.int64)) if np.asarray(leaf).ndim else 1
+        out.append(vec[off : off + n].reshape(np.asarray(leaf).shape))
+        off += n
+    if off != vec.size:
+        raise ValueError(f"flat vector size {vec.size} != template size {off}")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def dumps(obj: Any) -> bytes:
     """Serialize a pytree (nested dict/list/tuple of arrays, scalars, strings)."""
     return msgpack.packb(obj, default=_default, use_bin_type=True, strict_types=True)

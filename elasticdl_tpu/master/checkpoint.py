@@ -83,6 +83,12 @@ class CheckpointService:
         """reference: checkpoint_service.py:59-61."""
         return self.is_enabled() and version % self._steps == 0
 
+    def crossed(self, prev_version: int, version: int) -> bool:
+        """True when [prev, version] crossed a checkpoint multiple —
+        multi-step version bumps (local-update syncs) must not skip a
+        checkpoint just because they jumped over the exact multiple."""
+        return self.is_enabled() and version // self._steps > prev_version // self._steps
+
     def _path(self, version: int, is_eval: bool) -> str:
         d = self._eval_checkpoint_dir if is_eval else self._directory
         return os.path.join(d, f"model_v{version}.ckpt")

@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from elasticdl_tpu.common import codec
 from elasticdl_tpu.common.codec import IndexedRows, merge_indexed_rows
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.common.messages import MethodType, Task, TaskType
@@ -98,6 +99,7 @@ class MasterServicer:
             "GetModel": self.get_model,
             "ReportVariable": self.report_variable,
             "ReportGradient": self.report_gradient,
+            "ReportLocalUpdate": self.report_local_update,
             "ReportEvaluationMetrics": self.report_evaluation_metrics,
             "ReportTaskResult": self.report_task_result,
             "EmbeddingLookup": self.embedding_lookup,
@@ -166,6 +168,13 @@ class MasterServicer:
                     # model pulls (servicer.py:282-287): the worker
                     # already holds this version.
                     return {"version": self._version, "params": None, "aux": None}
+                if req.get("flat"):
+                    # single-buffer transport (see codec.ravel_np)
+                    return {
+                        "version": self._version,
+                        "params_flat": codec.ravel_np(self._params),
+                        "aux": jax.tree_util.tree_map(np.copy, self._aux),
+                    }
                 return {
                     "version": self._version,
                     "params": jax.tree_util.tree_map(np.copy, self._params),
@@ -206,11 +215,17 @@ class MasterServicer:
         with self._lock:
             if self._params is None:
                 raise ValueError("gradient reported before model init")
+            if grads is None and req.get("gradient_flat") is not None:
+                grads = codec.unravel_np(req["gradient_flat"], self._params)
             staleness = self._version - report_version
             if not self._use_async and staleness > self._staleness_window:
-                # stale: reject so the worker re-pulls and retries
-                # (reference: servicer.py:305-318)
-                return {"accepted": False, "version": self._version}
+                # stale: reject AND piggyback the fresh model so the
+                # worker's retry needs no separate pull round-trip
+                resp = {"accepted": False, "version": self._version}
+                if req.get("return_model"):
+                    resp["params_flat"] = codec.ravel_np(self._params)
+                    resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
+                return resp
             if report_version > self._version:
                 raise ValueError(
                     f"future gradient version {report_version} > {self._version}"
@@ -256,13 +271,19 @@ class MasterServicer:
                     self._edl_grads = {}
                     applied = True
             resp = {"accepted": True, "version": self._version}
+            if req.get("return_model") and self._version != report_version:
+                # a step was applied (by this report or a concurrent
+                # one): hand back the new model inline — the sync-SGD
+                # inner loop becomes ONE rpc per minibatch
+                resp["params_flat"] = codec.ravel_np(self._params)
+                resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
             if applied:
                 # snapshot the exact applied version UNDER the lock so a
                 # concurrent report can't skip a checkpoint/eval trigger;
                 # params are copied only when this version checkpoints
                 applied_version = self._version
-                if self._checkpoint_service and self._checkpoint_service.need_to_checkpoint(
-                    applied_version
+                if self._checkpoint_service and self._checkpoint_service.crossed(
+                    applied_version - 1, applied_version
                 ):
                     ckpt_snapshot = (
                         jax.tree_util.tree_map(np.copy, self._params),
@@ -271,7 +292,54 @@ class MasterServicer:
         if applied:
             # hooks run OUTSIDE the lock: the eval service calls back
             # into get_params_copy and must not deadlock
-            self._on_version_bump(applied_version, ckpt_snapshot)
+            self._on_version_bump(applied_version, ckpt_snapshot, applied_version - 1)
+        return resp
+
+    def report_local_update(self, req: dict) -> dict:
+        """SSP / local-update mode: the worker ran `steps` optimizer
+        updates ON DEVICE (the reference designed but never landed this
+        — doc/async_sgd_design.md:84-103, `get_model_frequency`) and
+        ships one cumulative parameter DELTA. The PS adds the delta,
+        advances the version by `steps`, and hands back the merged
+        model when the worker's base has fallen behind (another worker
+        synced in between).
+
+        For a single worker this is mathematically identical to
+        per-step sync SGD — the delta is exactly the sum of its local
+        updates — while moving the model over the wire once per window
+        instead of twice per minibatch."""
+        steps = int(req["steps"])
+        base_version = int(req["base_version"])
+        aux_state = req.get("aux_state")
+        applied_version = -1
+        ckpt_snapshot = None
+        with self._lock:
+            if self._params is None:
+                raise ValueError("local update reported before model init")
+            prev_version = self._version
+            delta = codec.unravel_np(req["delta_flat"], self._params)
+            self._params = jax.tree_util.tree_map(
+                lambda p, d: p + np.asarray(d, dtype=np.float32),
+                self._params,
+                delta,
+            )
+            if aux_state is not None:
+                self._aux = aux_state
+            self._version += steps
+            applied_version = self._version
+            if self._checkpoint_service and self._checkpoint_service.crossed(
+                prev_version, self._version
+            ):
+                ckpt_snapshot = (
+                    jax.tree_util.tree_map(np.copy, self._params),
+                    jax.tree_util.tree_map(np.copy, self._aux),
+                )
+            resp = {"version": self._version}
+            # base fell behind (concurrent syncs): return the merged model
+            if base_version + steps != self._version or req.get("want_model"):
+                resp["params_flat"] = codec.ravel_np(self._params)
+                resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
+        self._on_version_bump(applied_version, ckpt_snapshot, prev_version)
         return resp
 
     def _validate(self, grads):
@@ -306,17 +374,20 @@ class MasterServicer:
             self._params = self._opt.step(self._params, dense_grads)
         self._version += 1
 
-    def _on_version_bump(self, version: int, ckpt_snapshot=None):
+    def _on_version_bump(self, version: int, ckpt_snapshot=None, prev_version=None):
         """Checkpoint/eval hooks for an applied version. Caller must NOT
         hold the lock (reference fires these inside its mutex,
         servicer.py:269-280; here the eval hook re-enters
         get_params_copy). `ckpt_snapshot` was taken under the lock at
-        exactly `version`."""
+        exactly `version`. Cadence checks are floor-crossing so
+        multi-step bumps (local-update syncs) can't skip triggers."""
         if ckpt_snapshot is not None and self._checkpoint_service:
             params, aux = ckpt_snapshot
             self._checkpoint_service.save(params, version, aux=aux)
         if self._evaluation_service:
-            self._evaluation_service.add_evaluation_task_if_needed(version)
+            self._evaluation_service.add_evaluation_task_if_needed(
+                version, prev_version
+            )
 
     def set_evaluation_service(self, evaluation_service):
         """Late wiring: the eval service needs the servicer's model

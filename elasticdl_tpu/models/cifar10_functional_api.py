@@ -11,7 +11,10 @@ import flax.linen as nn
 import jax.numpy as jnp
 import optax
 
-from elasticdl_tpu.models.record_codec import decode_image_records
+from elasticdl_tpu.models.record_codec import (
+    decode_image_records,
+    normalize_on_device,
+)
 
 IMAGE_SHAPE = (32, 32, 3)
 NUM_CLASSES = 10
@@ -32,6 +35,7 @@ class VGGBlock(nn.Module):
 class Cifar10Model(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
+        x = normalize_on_device(x)
         for feats in (32, 64, 128):
             x = VGGBlock(feats)(x, train=train)
         x = x.reshape((x.shape[0], -1))
@@ -44,7 +48,8 @@ def custom_model():
 
 
 def dataset_fn(records, mode):
-    return decode_image_records(records, IMAGE_SHAPE)
+    # uint8 transport: 4x less host->device traffic; model normalizes
+    return decode_image_records(records, IMAGE_SHAPE, scale=False)
 
 
 def loss(outputs, labels):

@@ -8,7 +8,10 @@ import flax.linen as nn
 import jax.numpy as jnp
 import optax
 
-from elasticdl_tpu.models.record_codec import decode_image_records
+from elasticdl_tpu.models.record_codec import (
+    decode_image_records,
+    normalize_on_device,
+)
 
 IMAGE_SHAPE = (32, 32, 3)
 NUM_CLASSES = 10
@@ -22,6 +25,7 @@ class Cifar10Subclass(nn.Module):
         self.dense2 = nn.Dense(NUM_CLASSES)
 
     def __call__(self, x, train: bool = False):
+        x = normalize_on_device(x)
         for i, (conv, bn) in enumerate(zip(self.convs, self.bns)):
             x = nn.relu(bn(conv(x), use_running_average=not train))
             if i % 2 == 1:
@@ -36,7 +40,7 @@ def custom_model():
 
 
 def dataset_fn(records, mode):
-    return decode_image_records(records, IMAGE_SHAPE)
+    return decode_image_records(records, IMAGE_SHAPE, scale=False)
 
 
 def loss(outputs, labels):

@@ -19,7 +19,10 @@ import flax.linen as nn
 import jax.numpy as jnp
 import optax
 
-from elasticdl_tpu.models.record_codec import decode_image_records
+from elasticdl_tpu.models.record_codec import (
+    decode_image_records,
+    normalize_on_device,
+)
 
 IMAGE_SHAPE = (64, 64, 3)  # synthetic/test default; ImageNet uses 224
 NUM_CLASSES = 10
@@ -66,7 +69,7 @@ class ResNet50(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = x.astype(self.compute_dtype)
+        x = normalize_on_device(x).astype(self.compute_dtype)
         x = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False, dtype=self.compute_dtype)(x)
         x = nn.BatchNorm(
             use_running_average=not train,
@@ -95,7 +98,7 @@ def custom_model(num_classes: int = NUM_CLASSES, bfloat16: bool = False):
 
 
 def dataset_fn(records, mode):
-    return decode_image_records(records, IMAGE_SHAPE)
+    return decode_image_records(records, IMAGE_SHAPE, scale=False)
 
 
 def loss(outputs, labels):

@@ -1,0 +1,82 @@
+"""Mixture-of-Experts with expert parallelism (manual SPMD).
+
+No reference equivalent (SURVEY §2.10: EP absent upstream). GShard/
+Switch-style top-1 routing with capacity-bounded dense dispatch — the
+formulation that maps onto the MXU (dispatch/combine are einsums, not
+scatters) and onto ICI (`lax.all_to_all` over the `ep` mesh axis):
+
+  tokens --(dispatch einsum)--> [E, C, d] --all_to_all--> local experts
+  --ffn--> --all_to_all back--> (combine einsum) --> tokens
+
+Called inside `shard_map`; expert weights are sharded over `ep` (their
+leading E dim), the router weight is replicated. Tokens beyond an
+expert's capacity are dropped (standard Switch behavior) — size
+capacity_factor so drops are rare. Returns the Switch load-balancing
+auxiliary loss alongside the output.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def moe_ffn(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    w1_local: jnp.ndarray,
+    w2_local: jnp.ndarray,
+    axis_name: str,
+    capacity_factor: float = 2.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: [T, d] local tokens; router_w: [d, E] replicated;
+    w1_local: [E/ep, d, f]; w2_local: [E/ep, f, d].
+    -> ([T, d] output, scalar load-balance aux loss for the local shard).
+    """
+    ep = lax.axis_size(axis_name)
+    e_local, d, _f = w1_local.shape
+    num_experts = e_local * ep
+    t = x.shape[0]
+    # per-(source-rank, expert) slots; every rank sends ≤ C tokens to
+    # each expert, keeping the all_to_all block static-shaped
+    capacity = max(1, math.ceil(t * capacity_factor / num_experts))
+
+    logits = x @ router_w  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)  # [T]
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)  # [T, E]
+
+    # Switch aux loss: E * Σ_e (token fraction)·(mean router prob)
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+
+    # position of each token within its expert's send buffer
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T, E], -1 if not routed
+    keep = (pos >= 0) & (pos < capacity)  # [T, E]
+    slot = jnp.sum(jnp.where(keep, pos, 0.0), axis=-1).astype(jnp.int32)  # [T]
+    slot_onehot = jax.nn.one_hot(slot, capacity, dtype=x.dtype)  # [T, C]
+    # keep (routed AND under capacity) gates the whole row: dropped
+    # tokens dispatch nowhere and combine to zero
+    dispatch = keep.astype(x.dtype)[:, :, None] * slot_onehot[:, None, :]  # [T,E,C]
+    combine = dispatch * gate[:, None, None]  # [T, E, C]
+
+    xe = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, d]
+    xe = xe.reshape(ep, e_local, capacity, d)
+    # regroup by expert owner; received dim 0 indexes the source rank
+    xe = lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=0)
+    xe = xe.transpose(1, 0, 2, 3).reshape(e_local, ep * capacity, d)
+
+    h = jax.nn.gelu(jnp.einsum("egd,edf->egf", xe, w1_local))
+    ye = jnp.einsum("egf,efd->egd", h, w2_local)
+
+    ye = ye.reshape(e_local, ep, capacity, d).transpose(1, 0, 2, 3)
+    ye = lax.all_to_all(ye, axis_name, split_axis=0, concat_axis=0)
+    ye = ye.reshape(num_experts, capacity, d)
+    out = jnp.einsum("tec,ecd->td", combine, ye)
+    return out, aux

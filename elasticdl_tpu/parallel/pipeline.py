@@ -1,0 +1,98 @@
+"""Pipeline parallelism: SPMD GPipe over a `pp` mesh axis.
+
+No reference equivalent (SURVEY §2.10: PP absent upstream). Collective-
+permute pipelining in pure SPMD: every rank holds one stage's params
+(the stacked [pp, ...] stage dim is sharded over `pp` by shard_map) and
+runs the same program; activations stream rank→rank+1 with
+`lax.ppermute` each step. n_micro microbatches drain in
+n_micro + pp - 1 steps (the GPipe bubble); during bubble steps a rank
+computes on zeros and the result is masked out, which XLA overlaps
+with the permute.
+
+Differentiable end-to-end: the whole schedule is a `lax.scan`, so the
+backward pass replays the ring in reverse.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(
+    stage_fn: Callable,
+    stage_params,
+    micro: jnp.ndarray,
+    axis_name: str,
+    has_aux: bool = False,
+):
+    """Run `stage_fn(stage_params, x)` as a pp-deep pipeline.
+
+    micro: [n_micro, ...] microbatches, identical (replicated) on every
+    pp rank — e.g. embedded activations. Returns [n_micro, ...] outputs
+    valid on every rank (broadcast from the last stage).
+    stage_fn must preserve the activation shape (a transformer stage).
+
+    With `has_aux`, stage_fn returns (x, scalar) — e.g. an MoE
+    load-balance loss — and gpipe returns (outputs, aux) where aux is
+    the mean over real (non-bubble) stage executions, psum'd across pp.
+    """
+    from elasticdl_tpu.parallel.vma_util import match_vma
+
+    pp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = micro.shape[0]
+
+    # probe the stage's output type so the scan carries are promoted to
+    # the right varying axes on any mesh; the probe computation itself
+    # is dead code and DCE'd
+    probe = stage_fn(stage_params, micro[0])
+    probe_out, probe_aux = probe if has_aux else (probe, None)
+    state0 = match_vma(jnp.zeros_like(micro[0]), probe_out)
+    out0 = match_vma(jnp.zeros_like(micro), probe_out, micro)
+    aux0 = (
+        match_vma(jnp.zeros((), dtype=micro.dtype), probe_aux, probe_out)
+        if has_aux
+        else jnp.zeros((), dtype=micro.dtype)
+    )
+
+    def step(carry, t):
+        state, outputs, aux_sum = carry
+        feed = lax.dynamic_index_in_dim(
+            micro, jnp.clip(t, 0, n_micro - 1), keepdims=False
+        )
+        inp = jnp.where(idx == 0, feed, state)
+        if has_aux:
+            out, aux = stage_fn(stage_params, inp)
+            # this rank works on microbatch t-idx; bubble steps compute
+            # on zeros and their aux must not bias the mean
+            real = (t - idx >= 0) & (t - idx < n_micro)
+            aux_sum = aux_sum + jnp.where(real, aux, 0.0)
+        else:
+            out = stage_fn(stage_params, inp)
+        # the last rank finishes microbatch t-(pp-1) at step t
+        done_t = t - (pp - 1)
+        upd = jnp.clip(done_t, 0, n_micro - 1)
+        valid = (idx == pp - 1) & (done_t >= 0)
+        cur = lax.dynamic_index_in_dim(outputs, upd, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, out, cur), upd, axis=0
+        )
+        # stream to the next stage (no wraparound; rank 0 feeds fresh data)
+        state = lax.ppermute(
+            out, axis_name, [(j, j + 1) for j in range(pp - 1)]
+        )
+        return (state, outputs, aux_sum), None
+
+    (_, outputs, aux_sum), _ = lax.scan(
+        step, (state0, out0, aux0), jnp.arange(n_micro + pp - 1)
+    )
+    # broadcast the last stage's outputs to every rank so the loss (and
+    # its gradient) is computed consistently everywhere
+    outputs = lax.psum(jnp.where(idx == pp - 1, outputs, 0.0), axis_name)
+    if has_aux:
+        # mean over the pp*n_micro real stage executions
+        return outputs, lax.psum(aux_sum, axis_name) / (pp * n_micro)
+    return outputs

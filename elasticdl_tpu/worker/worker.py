@@ -71,6 +71,8 @@ class Worker:
         minibatch_size: int,
         mesh=None,  # optional local dp Mesh for multi-chip hosts
         transport_dtype: str = "float32",
+        flat_transport: bool = True,
+        local_updates: int = 0,
         seed: int = 0,
     ):
         self._id = worker_id
@@ -79,11 +81,49 @@ class Worker:
         self._minibatch_size = minibatch_size
         self._mesh = mesh
         self._transport_dtype = transport_dtype
-        self._rng = jax.random.PRNGKey(seed + worker_id)
+        # rng lives on CPU: eager host-side ops (init, embedding row
+        # draws) must not become per-op round-trips to a remote device
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            self._rng = jax.random.PRNGKey(seed + worker_id)
 
         self._params = None  # trainable pytree (device)
         self._aux: Dict[str, Any] = {}  # non-trainable collections
         self._version = -1
+
+        # Flat transport (TPU-first hot-loop redesign): the model rides
+        # the wire AND the host<->device boundary as ONE contiguous f32
+        # buffer (codec.ravel_np), and ReportGradient piggybacks the
+        # updated model on its response — steady-state sync-SGD is one
+        # RPC, one h2d and one d2h bulk transfer per minibatch, instead
+        # of two RPCs plus a transfer per parameter leaf. This is what
+        # makes the PS design survive a high-latency link to the chip.
+        self._flat_transport = flat_transport
+        self._template = None  # host pytree defining structure/shapes
+        self._unravel = None  # jit-side flat -> tree
+        self._flat = None  # device [n_params] f32 buffer
+        self._fresh = False  # local params == PS latest (skip next pull)
+
+        # Local-update / SSP mode (the reference's designed-never-landed
+        # async path, doc/async_sgd_design.md:84-103): run the optimizer
+        # ON DEVICE for `local_updates` minibatches with donated
+        # buffers, then push one cumulative parameter delta to the PS
+        # (servicer.report_local_update). For one worker this matches
+        # per-step sync SGD exactly; for many it is local SGD / SSP.
+        # Zero per-step host<->device traffic except the feature batch.
+        self._local_updates = local_updates
+        self._local_step_fn = None
+        self._opt_state = None
+        self._base_flat = None  # device copy of params at last sync
+        self._base_version = -1
+        self._pending_steps = 0
+        self._sync_thread = None  # in-flight async delta push
+        self._sync_result = None  # (version, params_flat, aux) from it
+        self._deferred_reports: list = []  # task results gated on sync
+        if local_updates and model_spec.embedding_specs:
+            raise ValueError(
+                "local_updates mode does not support PS-resident "
+                "embeddings (sparse grads must reach the PS every step)"
+            )
 
         self._readers = ReaderCache()
         self._train_step = None
@@ -103,22 +143,66 @@ class Worker:
 
     def pull_model(self, min_version: int = -1, method: str = MethodType.MINIMUM):
         """reference: worker.py:103-124 (var assign becomes pytree swap)."""
+        use_flat = (
+            self._flat_transport
+            and method == MethodType.MINIMUM
+            and self._template is not None
+        )
         req = {"version": min_version, "method": method}
         if method == MethodType.MINIMUM:
             req["only_if_newer"] = True
             req["version"] = self._version
+            if use_flat:
+                req["flat"] = True
         resp = self._master.call("GetModel", req)
         if resp["version"] < 0:
             return False  # master model not initialized yet
-        if resp["params"] is not None:
+        if use_flat and resp.get("params_flat") is not None:
+            self._set_flat(resp["params_flat"], resp.get("aux"))
+        elif resp.get("params") is not None:
             self._params = jax.tree_util.tree_map(jnp.asarray, resp["params"])
             self._aux = (
                 jax.tree_util.tree_map(jnp.asarray, resp["aux"])
                 if resp.get("aux")
                 else {}
             )
+            self._maybe_init_flat_from_tree(resp["params"])
+            if self._use_flat():
+                # tree-form pulls (e.g. FIXED eval snapshots) must also
+                # refresh the flat buffer the jitted steps consume
+                from elasticdl_tpu.common import codec
+
+                self._flat = jnp.asarray(codec.ravel_np(resp["params"]))
         self._version = resp["version"]
+        if method == MethodType.MINIMUM:
+            self._fresh = True
         return True
+
+    # -------------------------------------------------- flat-transport state
+
+    def _maybe_init_flat_from_tree(self, host_params):
+        """Learn the model structure from a tree-form pull/init and set
+        up the single-buffer path (float models only)."""
+        if not self._flat_transport or self._template is not None:
+            return
+        from elasticdl_tpu.common import codec
+
+        host_params = jax.tree_util.tree_map(np.asarray, host_params)
+        if not codec.all_float_leaves(host_params):
+            self._flat_transport = False  # exotic dtypes: tree path
+            return
+        from jax.flatten_util import ravel_pytree
+
+        self._template = host_params
+        _flat0, self._unravel = ravel_pytree(
+            jax.tree_util.tree_map(jnp.asarray, host_params)
+        )
+        self._flat = jnp.asarray(codec.ravel_np(host_params))
+
+    def _set_flat(self, vec, aux):
+        self._flat = jnp.asarray(np.asarray(vec, dtype=np.float32))
+        if aux:
+            self._aux = jax.tree_util.tree_map(jnp.asarray, aux)
 
     def report_variable(self):
         self._master.call(
@@ -131,20 +215,21 @@ class Worker:
             },
         )
 
-    def report_gradient(self, grads, edl_grads, aux_state):
-        grads_np = jax.tree_util.tree_map(self._to_wire_dtype, grads)
-        return self._master.call(
-            "ReportGradient",
-            {
-                "worker_id": self._id,
-                "version": self._version,
-                "gradient": grads_np,
-                "edl_gradient": edl_grads or None,
-                "aux_state": jax.tree_util.tree_map(np.asarray, aux_state)
-                if aux_state
-                else None,
-            },
-        )
+    def report_gradient(self, grads, edl_grads, aux_state, flat: bool = False):
+        req = {
+            "worker_id": self._id,
+            "version": self._version,
+            "edl_gradient": edl_grads or None,
+            "aux_state": jax.tree_util.tree_map(np.asarray, aux_state)
+            if aux_state
+            else None,
+        }
+        if flat:
+            req["gradient_flat"] = self._to_wire_dtype(grads)
+            req["return_model"] = True
+        else:
+            req["gradient"] = jax.tree_util.tree_map(self._to_wire_dtype, grads)
+        return self._master.call("ReportGradient", req)
 
     def _to_wire_dtype(self, g):
         g = np.asarray(g)
@@ -239,17 +324,27 @@ class Worker:
         if self._emb_specs:
             args.append(embeddings)
         kwargs = {"train": False} if self._takes_train_kwarg() else {}
-        variables = model.init(self._rng, *args, **kwargs)
-        variables = jax.tree_util.tree_map(jnp.asarray, variables)
+        # init on CPU: flax init is eager op-by-op, which over a remote
+        # device link costs a round-trip per op (~60s for ResNet-scale
+        # models); on host it is milliseconds, then ONE bulk transfer
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            variables = model.init(self._rng, *args, **kwargs)
+        variables = jax.tree_util.tree_map(np.asarray, variables)
         self._params = variables["params"]
         self._aux = {k: v for k, v in variables.items() if k != "params"}
+        self._maybe_init_flat_from_tree(self._params)
+        if not self._use_flat():
+            self._params = jax.tree_util.tree_map(jnp.asarray, self._params)
+        self._aux = jax.tree_util.tree_map(jnp.asarray, self._aux)
 
     def _build_train_step(self):
         spec = self._spec
         has_emb = bool(self._emb_specs)
+        unravel = self._unravel if (self._flat_transport and self._template is not None) else None
 
-        def step(params, aux, bets, bet_aux, features, labels):
-            def loss_fn(params, bets):
+        def step(params_in, aux, bets, bet_aux, features, labels):
+            def loss_fn(params_in, bets):
+                params = unravel(params_in) if unravel else params_in
                 embeddings = (
                     {
                         k: EmbeddingInput(bets[k], bet_aux[k][0], bet_aux[k][1])
@@ -264,9 +359,11 @@ class Worker:
                 )
                 return spec.loss(outputs, labels), new_aux
 
+            # grad wrt params_in: already a flat vector in flat mode
+            # (the unravel lives inside loss_fn), a tree otherwise
             (loss, new_aux), grads = jax.value_and_grad(
                 loss_fn, argnums=(0, 1) if has_emb else 0, has_aux=True
-            )(params, bets)
+            )(params_in, bets)
             if has_emb:
                 gparams, gbets = grads
             else:
@@ -302,8 +399,10 @@ class Worker:
     def _build_eval_step(self):
         spec = self._spec
         has_emb = bool(self._emb_specs)
+        unravel = self._unravel if (self._flat_transport and self._template is not None) else None
 
-        def step(params, aux, bets, bet_aux, features, labels):
+        def step(params_in, aux, bets, bet_aux, features, labels):
+            params = unravel(params_in) if unravel else params_in
             embeddings = (
                 {
                     k: EmbeddingInput(bets[k], bet_aux[k][0], bet_aux[k][1])
@@ -347,27 +446,185 @@ class Worker:
         n = len(jax.tree_util.tree_leaves(features)[0])
         return n % self._mesh.size == 0
 
-    def _process_minibatch(self, features, labels, task: Task) -> float:
-        """Sync-SGD retry loop (reference: worker.py:347-388)."""
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
-            self._eval_step = self._build_eval_step()
+    def _use_flat(self) -> bool:
+        return self._flat_transport and self._template is not None
 
-        for _ in range(MAX_MINIBATCH_RETRY_NUM):
+    def _step_params(self):
+        return self._flat if self._use_flat() else self._params
+
+    # ------------------------------------------------- local-update training
+
+    def _build_local_step(self):
+        """Fused jitted step: loss+grad AND the optax update on device,
+        with donated param/opt buffers — the hot loop never moves the
+        model off-device. optax transforms are elementwise, so running
+        them on the flat vector is identical math to the tree form."""
+        assert self._use_flat(), "local mode requires flat transport"
+        spec = self._spec
+        tx = spec.optimizer()
+        unravel = self._unravel
+
+        def step(flat, opt_state, aux, features, labels):
+            def loss_fn(flat):
+                params = unravel(flat)
+                variables = {"params": params, **aux}
+                outputs, new_aux = self._apply_model(
+                    variables, features, None, train=True
+                )
+                return spec.loss(outputs, labels), new_aux
+
+            (loss, new_aux), grad = jax.value_and_grad(loss_fn, has_aux=True)(
+                flat
+            )
+            updates, opt_state = tx.update(grad, opt_state, flat)
+            return flat + updates, opt_state, new_aux, loss
+
+        if self._mesh is None or self._mesh.size <= 1:
+            return jax.jit(step, donate_argnums=(0, 1))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self._mesh, P())
+        batch = NamedSharding(self._mesh, P(self._mesh.axis_names[0]))
+        return jax.jit(
+            step,
+            in_shardings=(repl, repl, repl, batch, batch),
+            out_shardings=repl,
+            donate_argnums=(0, 1),
+        )
+
+    def _local_minibatch(self, features, labels, task: Task):
+        if self._pending_steps == 0:
+            self._join_sync()  # absorb any async sync before rebasing
+        if self._pending_steps == 0 and (
+            not self._fresh or self._version < task.model_version
+        ):
             if not self.pull_model(max(self._version, task.model_version)):
-                # master uninitialized: init from our side (lazy PS init,
-                # reference worker.py:278-282, servicer.py:299-303)
-                embs = self._prepare_embeddings(features)
-                dev_embs = {k: b for k, b in embs.items()}
-                self._init_model(features, self._dev_embedding_inputs(dev_embs))
+                self._init_model(features, None)
                 self.report_variable()
                 self.pull_model()
+            self._opt_state = None  # params swapped: restart opt state
+        if self._local_step_fn is None:
+            self._local_step_fn = self._build_local_step()
+        if self._opt_state is None:
+            tx = self._spec.optimizer()
+            self._opt_state = tx.init(self._flat)
+            self._base_flat = jnp.copy(self._flat)
+            self._base_version = self._version
+        self._flat, self._opt_state, new_aux, loss = self._local_step_fn(
+            self._flat, self._opt_state, self._aux, features, labels
+        )
+        self._aux = new_aux or self._aux
+        self._pending_steps += 1
+        if self._pending_steps >= self._local_updates:
+            self._sync_local_updates()
+        return loss  # device array; resolve lazily so steps pipeline
+
+    def _sync_local_updates(self, blocking: bool = True):
+        """Push the cumulative delta: one d2h + one RPC per window.
+
+        With blocking=False the d2h and the RPC ride a background
+        thread and the device keeps training the next window — the sync
+        leaves the critical path entirely. Elastic semantics are
+        preserved by deferring ReportTaskResult until the covering sync
+        lands (`_defer_report`): work that dies unsynced dies
+        unreported, so the dispatcher requeues it."""
+        self._join_sync()
+        if not self._pending_steps:
+            self._flush_deferred_reports()
+            return
+        delta_dev = self._flat - self._base_flat  # own buffer, thread-safe
+        steps = self._pending_steps
+        base_version = self._base_version
+        aux_host = (
+            jax.tree_util.tree_map(np.asarray, self._aux) if self._aux else None
+        )
+        self._base_flat = jnp.copy(self._flat)
+        self._pending_steps = 0
+
+        def do_sync():
+            resp = self._master.call(
+                "ReportLocalUpdate",
+                {
+                    "delta_flat": self._to_wire_dtype(np.asarray(delta_dev)),
+                    "steps": steps,
+                    "base_version": base_version,
+                    "aux_state": aux_host,
+                },
+            )
+            self._sync_result = (
+                resp["version"],
+                resp.get("params_flat"),
+                resp.get("aux"),
+            )
+            self._flush_deferred_reports()
+
+        if blocking:
+            do_sync()
+            self._absorb_sync_result()
+        else:
+            import threading
+
+            self._sync_thread = threading.Thread(target=do_sync, daemon=True)
+            self._sync_thread.start()
+
+    def _join_sync(self):
+        """Wait for an in-flight async sync and absorb its result."""
+        if self._sync_thread is not None:
+            self._sync_thread.join()
+            self._sync_thread = None
+        self._absorb_sync_result()
+
+    def _absorb_sync_result(self):
+        if self._sync_result is None:
+            return
+        version, params_flat, aux = self._sync_result
+        self._sync_result = None
+        self._version = version
+        if params_flat is not None:
+            # another worker advanced the PS: rebase — merged model plus
+            # our still-unsynced local steps (local-SGD merge)
+            merged = jnp.asarray(np.asarray(params_flat, dtype=np.float32))
+            self._flat = merged + (self._flat - self._base_flat)
+            self._base_flat = merged
+            if aux:
+                self._aux = jax.tree_util.tree_map(jnp.asarray, aux)
+        self._base_version = version
+        self._fresh = True
+
+    def _defer_report(self, task_id: int, err: str):
+        self._deferred_reports.append((task_id, err))
+
+    def _flush_deferred_reports(self):
+        while self._deferred_reports:
+            task_id, err = self._deferred_reports.pop(0)
+            self._master.call(
+                "ReportTaskResult", {"task_id": task_id, "err_message": err}
+            )
+
+    def _process_minibatch(self, features, labels, task: Task) -> float:
+        """Sync-SGD retry loop (reference: worker.py:347-388). With flat
+        transport the steady state is ONE ReportGradient per minibatch:
+        the response piggybacks the updated model, so no separate pull."""
+        for _ in range(MAX_MINIBATCH_RETRY_NUM):
+            if not self._fresh or self._version < task.model_version:
+                if not self.pull_model(max(self._version, task.model_version)):
+                    # master uninitialized: init from our side (lazy PS
+                    # init, reference worker.py:278-282, servicer.py:299-303)
+                    embs = self._prepare_embeddings(features)
+                    self._init_model(features, self._dev_embedding_inputs(embs))
+                    self.report_variable()
+                    self.pull_model()
+            if self._train_step is None:
+                # built after the first pull/init so the flat-transport
+                # template is known
+                self._train_step = self._build_train_step()
+                self._eval_step = self._build_eval_step()
             embs = self._prepare_embeddings(features)
             step = self._train_step
             if not self._divisible(features):
                 step = self._ragged_train_step()
             loss, gparams, gbets, new_aux = step(
-                self._params, self._aux, embs, features, labels
+                self._step_params(), self._aux, embs, features, labels
             )
             edl_grads = {
                 name: extract_indexed_grads(
@@ -375,10 +632,28 @@ class Worker:
                 )
                 for name in gbets
             }
-            resp = self.report_gradient(gparams, edl_grads, new_aux)
+            flat = self._use_flat()
+            resp = self.report_gradient(
+                np.asarray(gparams) if flat else gparams,
+                edl_grads,
+                new_aux,
+                flat=flat,
+            )
+            self._absorb_report_response(resp)
             if resp["accepted"]:
                 return float(loss)
         raise RuntimeError("worker stuck: minibatch retries exhausted")
+
+    def _absorb_report_response(self, resp):
+        """Track freshness + absorb a piggybacked model."""
+        if resp.get("params_flat") is not None and self._use_flat():
+            self._set_flat(resp["params_flat"], resp.get("aux"))
+            self._version = resp["version"]
+            self._fresh = True
+        elif resp["version"] == self._version:
+            self._fresh = True  # nothing applied yet; still current
+        else:
+            self._fresh = False
 
     def _ragged_train_step(self):
         """Uncached single-device fallback for batches not divisible by
@@ -406,19 +681,27 @@ class Worker:
         for features, labels in PrefetchParser(
             chunks, lambda c: self._parse(c, Mode.TRAINING)
         ):
-            loss = self._process_minibatch(features, labels, task)
+            if self._local_updates:
+                loss = self._local_minibatch(features, labels, task)
+            else:
+                loss = self._process_minibatch(features, labels, task)
+        if self._local_updates:
+            # async sync at the task boundary; the task's result report
+            # is deferred until this sync lands (elastic correctness:
+            # unsynced work must look unfinished to the dispatcher)
+            self._sync_local_updates(blocking=False)
         logger.info(
             "Worker %d task %d done (last loss %.4f, v%d)",
             self._id,
             task.task_id,
-            loss,
+            float(loss),
             self._version,
         )
 
     def _process_evaluation_task(self, task: Task):
         """Version-pinned eval (reference: worker.py:354-358, FIXED pull
         served from the eval snapshot, servicer.py:128-139)."""
-        saved = (self._params, self._aux, self._version)
+        saved = (self._params, self._aux, self._version, self._flat, self._fresh)
         try:
             self.pull_model(task.model_version, MethodType.FIXED)
             if self._eval_step is None:
@@ -433,7 +716,7 @@ class Worker:
                     if self._divisible(features)
                     else self._ragged_eval_step()
                 )
-                outputs = step(self._params, self._aux, embs, features, labels)
+                outputs = step(self._step_params(), self._aux, embs, features, labels)
                 metrics = {
                     k: float(v)
                     for k, v in self._spec.eval_metrics_fn(
@@ -450,7 +733,13 @@ class Worker:
                     },
                 )
         finally:
-            self._params, self._aux, self._version = saved
+            (
+                self._params,
+                self._aux,
+                self._version,
+                self._flat,
+                self._fresh,
+            ) = saved
 
     def _ragged_eval_step(self):
         if not hasattr(self, "_ragged_eval"):
@@ -476,7 +765,7 @@ class Worker:
                 if self._divisible(features)
                 else self._ragged_eval_step()
             )
-            outputs = step(self._params, self._aux, embs, features, None)
+            outputs = step(self._step_params(), self._aux, embs, features, None)
             proc = self._spec.prediction_outputs_processor
             if proc is not None:
                 proc.process(np.asarray(outputs), self._id)
