@@ -86,7 +86,16 @@ class CheckpointService:
     def crossed(self, prev_version: int, version: int) -> bool:
         """True when [prev, version] crossed a checkpoint multiple —
         multi-step version bumps (local-update syncs) must not skip a
-        checkpoint just because they jumped over the exact multiple."""
+        checkpoint just because they jumped over the exact multiple.
+
+        Known cadence drift vs the reference (checkpoint_service.py:59-61
+        saves exactly at version % steps == 0): when a multi-step bump
+        jumps over one or more multiples, a single snapshot is saved at
+        the *post-bump* version (`model_v{applied}`), which is generally
+        not itself a multiple of `checkpoint_steps`. This is deliberate:
+        the PS only holds the post-bump state, and saving one snapshot
+        per crossing preserves the every-N-versions *cadence* even
+        though filenames leave the N-step grid."""
         return self.is_enabled() and version // self._steps > prev_version // self._steps
 
     def _path(self, version: int, is_eval: bool) -> str:

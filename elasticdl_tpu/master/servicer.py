@@ -137,10 +137,15 @@ class MasterServicer:
             # its EVALUATION tasks may not have been enqueued yet
             if finished and self._evaluation_service is not None:
                 finished = not self._evaluation_service.has_pending()
-            return {
+            resp = {
                 "task": Task(type=TaskType.WAIT).to_wire(),
                 "finished": finished,
             }
+            if finished and self._task_d is not None:
+                # a poison task was dropped: completion is partial; the
+                # master exit path and workers must not report success
+                resp["failed"] = self._task_d.has_failed_tasks()
+            return resp
         return {"task": task.to_wire(), "finished": False}
 
     def report_task_result(self, req: dict) -> dict:
@@ -317,9 +322,24 @@ class MasterServicer:
             if self._params is None:
                 raise ValueError("local update reported before model init")
             prev_version = self._version
+            # Staleness policy: with `staleness_window > 0`, a delta
+            # whose base fell more than the window behind is
+            # down-weighted by window/staleness instead of applied at
+            # full weight (a worker that slept through many syncs must
+            # not drag the model back toward its stale base). Note the
+            # semantics differ from the sync path by necessity: there
+            # the window relaxes *rejection* and `lr_staleness_modulation`
+            # separately opts into down-weighting; deltas have no
+            # reject-and-retry protocol, so here the window alone
+            # enables down-weighting and nothing is ever rejected.
+            scale = 1.0
+            if self._staleness_window:
+                staleness = self._version - base_version
+                if staleness > self._staleness_window:
+                    scale = self._staleness_window / float(staleness)
             delta = codec.unravel_np(req["delta_flat"], self._params)
             self._params = jax.tree_util.tree_map(
-                lambda p, d: p + np.asarray(d, dtype=np.float32),
+                lambda p, d: p + scale * np.asarray(d, dtype=np.float32),
                 self._params,
                 delta,
             )

@@ -21,6 +21,7 @@ Re-design of the reference worker
 from __future__ import annotations
 
 import inspect
+import threading
 import time
 from typing import Any, Dict, NamedTuple, Optional
 
@@ -118,7 +119,10 @@ class Worker:
         self._pending_steps = 0
         self._sync_thread = None  # in-flight async delta push
         self._sync_result = None  # (version, params_flat, aux) from it
+        self._sync_error = None  # exception raised by the async push
         self._deferred_reports: list = []  # task results gated on sync
+        self._report_lock = threading.Lock()  # main + sync threads
+        self._job_failed = False  # master reported partial completion
         if local_updates and model_spec.embedding_specs:
             raise ValueError(
                 "local_updates mode does not support PS-resident "
@@ -139,6 +143,7 @@ class Worker:
 
     def get_task(self):
         resp = self._master.call("GetTask", {"worker_id": self._id})
+        self._job_failed = resp.get("failed", False)
         return Task.from_wire(resp["task"]), resp.get("finished", False)
 
     def pull_model(self, min_version: int = -1, method: str = MethodType.MINIMUM):
@@ -559,12 +564,24 @@ class Worker:
             self._flush_deferred_reports()
 
         if blocking:
-            do_sync()
+            try:
+                do_sync()
+            except Exception as e:
+                # the window's work never reached the PS: surface the
+                # covered tasks as failures so the dispatcher requeues
+                self._flush_deferred_reports(err=f"sync failed: {e}")
+                self._reset_local_state()
+                raise
             self._absorb_sync_result()
         else:
-            import threading
 
-            self._sync_thread = threading.Thread(target=do_sync, daemon=True)
+            def thread_main():
+                try:
+                    do_sync()
+                except Exception as e:  # joined + re-raised in _join_sync
+                    self._sync_error = e
+
+            self._sync_thread = threading.Thread(target=thread_main, daemon=True)
             self._sync_thread.start()
 
     def _join_sync(self):
@@ -572,7 +589,25 @@ class Worker:
         if self._sync_thread is not None:
             self._sync_thread.join()
             self._sync_thread = None
+        if self._sync_error is not None:
+            err, self._sync_error = self._sync_error, None
+            self._flush_deferred_reports(err=f"sync failed: {err}")
+            self._reset_local_state()
+            raise RuntimeError(f"local-update sync failed: {err}") from err
         self._absorb_sync_result()
+
+    def _reset_local_state(self):
+        """After a failed sync the local params carry a delta the PS
+        never received; training on would diverge permanently (and the
+        lost tasks get re-trained on top of the phantom delta). Drop
+        everything local and force a full model re-pull: version -1
+        defeats the `only_if_newer` pull optimisation even when the PS
+        version did not advance."""
+        self._fresh = False
+        self._version = -1
+        self._opt_state = None
+        self._pending_steps = 0
+        self._sync_result = None
 
     def _absorb_sync_result(self):
         if self._sync_result is None:
@@ -592,13 +627,21 @@ class Worker:
         self._fresh = True
 
     def _defer_report(self, task_id: int, err: str):
-        self._deferred_reports.append((task_id, err))
+        with self._report_lock:
+            self._deferred_reports.append((task_id, err))
 
-    def _flush_deferred_reports(self):
-        while self._deferred_reports:
-            task_id, err = self._deferred_reports.pop(0)
+    def _flush_deferred_reports(self, err: Optional[str] = None):
+        """Report deferred task results; `err` overrides each entry's
+        own message (used when the covering sync failed, so the
+        dispatcher requeues the window's tasks)."""
+        while True:
+            with self._report_lock:
+                if not self._deferred_reports:
+                    return
+                task_id, own_err = self._deferred_reports.pop(0)
             self._master.call(
-                "ReportTaskResult", {"task_id": task_id, "err_message": err}
+                "ReportTaskResult",
+                {"task_id": task_id, "err_message": err or own_err},
             )
 
     def _process_minibatch(self, features, labels, task: Task) -> float:
@@ -674,7 +717,9 @@ class Worker:
         feats, labels = self._spec.dataset_fn(chunk, mode)
         return feats, labels
 
-    def _process_training_task(self, task: Task):
+    def _process_training_task(self, task: Task) -> bool:
+        """Returns True if the task's result report was handled here
+        (deferred behind the covering sync) rather than by `run()`."""
         reader = self._readers.get(task.shard_file_name)
         records = list(reader.read_range(task.start, task.end))
         chunks = iter_minibatches(records, self._minibatch_size)
@@ -685,10 +730,15 @@ class Worker:
                 loss = self._local_minibatch(features, labels, task)
             else:
                 loss = self._process_minibatch(features, labels, task)
+        deferred = False
         if self._local_updates:
             # async sync at the task boundary; the task's result report
             # is deferred until this sync lands (elastic correctness:
-            # unsynced work must look unfinished to the dispatcher)
+            # unsynced work must look unfinished to the dispatcher, so a
+            # worker preempted before the sync gets its data requeued).
+            # Defer BEFORE starting the sync so its flush covers us.
+            self._defer_report(task.task_id, "")
+            deferred = True
             self._sync_local_updates(blocking=False)
         logger.info(
             "Worker %d task %d done (last loss %.4f, v%d)",
@@ -697,6 +747,7 @@ class Worker:
             float(loss),
             self._version,
         )
+        return deferred
 
     def _process_evaluation_task(self, task: Task):
         """Version-pinned eval (reference: worker.py:354-358, FIXED pull
@@ -772,22 +823,34 @@ class Worker:
 
     # ------------------------------------------------------------- main loop
 
-    def run(self):
+    def run(self) -> bool:
         """Task loop (reference: worker.py:432-463). Each task is pulled,
         processed to completion, and reported; failures report the error
-        so the master requeues the shard."""
+        so the master requeues the shard.
+
+        Returns True on clean completion, False when the master reported
+        the job finished with failed (dropped poison) tasks — callers
+        must not treat a partial-data model as a passing run."""
         while True:
             task, finished = self.get_task()
             if task.type == TaskType.WAIT:
                 if finished:
+                    self._finalize_local_updates()
+                    if self._job_failed:
+                        logger.warning(
+                            "Worker %d: job finished WITH FAILED TASKS "
+                            "(partial data)", self._id,
+                        )
+                        return False
                     logger.info("Worker %d: job finished, exiting", self._id)
-                    return
+                    return True
                 time.sleep(0.2)
                 continue
             err = ""
+            reported = False
             try:
                 if task.type == TaskType.TRAINING:
-                    self._process_training_task(task)
+                    reported = self._process_training_task(task)
                 elif task.type == TaskType.EVALUATION:
                     self._process_evaluation_task(task)
                 elif task.type == TaskType.PREDICTION:
@@ -797,7 +860,24 @@ class Worker:
             except Exception as e:
                 logger.exception("Worker %d task %d failed", self._id, task.task_id)
                 err = f"{type(e).__name__}: {e}"
-            self.report_task_result(task.task_id, err)
+            if not reported:
+                self.report_task_result(task.task_id, err)
+
+    def _finalize_local_updates(self):
+        """Drain local-update state before exit: join the in-flight
+        async sync, push any unsynced window, flush deferred reports.
+        Without this the final window's delta rides a daemon thread and
+        can be dropped at process exit (and in-process callers racing
+        `run()`'s return would read a pre-sync model)."""
+        if not self._local_updates:
+            return
+        self._join_sync()
+        if self._pending_steps:
+            self._sync_local_updates(blocking=True)
+        self._flush_deferred_reports()
 
     def close(self):
-        self._readers.close()
+        try:
+            self._finalize_local_updates()
+        finally:
+            self._readers.close()
