@@ -1,0 +1,68 @@
+"""Per-phase step timing for the worker hot loop.
+
+The reference's only perf artifact is a manual timing table splitting
+the training step into get_batch / input_fn / compute_loss / get_model /
+report_gradient (elasticdl/doc/worker_optimization_design.md:33-60);
+SURVEY §5.1 asks for this as a first-class subsystem since the
+north-star metric is throughput retention. `PhaseTimers` is that
+subsystem: near-zero-overhead cumulative wall-clock per phase,
+snapshot-able by benches and loggable per task.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict
+
+
+class PhaseTimers:
+    """Phases may nest (e.g. `compute` wraps `get_model` and
+    `report_gradient` in the sync hot loop); each phase is charged its
+    *exclusive* time — child durations are subtracted from the parent —
+    so the breakdown sums to real wall clock and percentages are
+    honest."""
+
+    def __init__(self):
+        self._seconds: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._stack: list = []  # (name, child_seconds) of open phases
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        self._stack.append([name, 0.0])
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - t0
+            _, child = self._stack.pop()
+            self._seconds[name] += elapsed - child
+            self._counts[name] += 1
+            if self._stack:
+                self._stack[-1][1] += elapsed
+
+    def add(self, name: str, seconds: float):
+        self._seconds[name] += seconds
+        self._counts[name] += 1
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {"seconds": self._seconds[k], "count": self._counts[k]}
+            for k in self._seconds
+        }
+
+    def summary(self) -> str:
+        total = sum(self._seconds.values()) or 1.0
+        parts = [
+            f"{k}={v:.2f}s({100 * v / total:.0f}%)"
+            for k, v in sorted(
+                self._seconds.items(), key=lambda kv: -kv[1]
+            )
+        ]
+        return " ".join(parts)
+
+    def reset(self):
+        self._seconds.clear()
+        self._counts.clear()

@@ -38,6 +38,7 @@ from elasticdl_tpu.api.layers import (
 from elasticdl_tpu.api.model_spec import ModelSpec
 from elasticdl_tpu.common.constants import MAX_MINIBATCH_RETRY_NUM, Mode
 from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.common.timing import PhaseTimers
 from elasticdl_tpu.common.messages import MethodType, Task, TaskType
 from elasticdl_tpu.worker.task_data_service import (
     PrefetchParser,
@@ -113,6 +114,7 @@ class Worker:
         # Zero per-step host<->device traffic except the feature batch.
         self._local_updates = local_updates
         self._local_step_fn = None
+        self._local_window_fn = None  # scanned whole-window step
         self._opt_state = None
         self._base_flat = None  # device copy of params at last sync
         self._base_version = -1
@@ -123,6 +125,11 @@ class Worker:
         self._deferred_reports: list = []  # task results gated on sync
         self._report_lock = threading.Lock()  # main + sync threads
         self._job_failed = False  # master reported partial completion
+        self.last_loss = None  # final minibatch loss of the last task
+        # per-phase wall-clock mirroring the reference's timing study
+        # (doc/worker_optimization_design.md:33-60): get_batch /
+        # compute / get_model / report_gradient / sync_wait / read
+        self.timers = PhaseTimers()
         if local_updates and model_spec.embedding_specs:
             raise ValueError(
                 "local_updates mode does not support PS-resident "
@@ -213,10 +220,8 @@ class Worker:
         self._master.call(
             "ReportVariable",
             {
-                "params": jax.tree_util.tree_map(np.asarray, self._params),
-                "aux": jax.tree_util.tree_map(np.asarray, self._aux)
-                if self._aux
-                else None,
+                "params": jax.device_get(self._params),
+                "aux": jax.device_get(self._aux) if self._aux else None,
             },
         )
 
@@ -225,9 +230,9 @@ class Worker:
             "worker_id": self._id,
             "version": self._version,
             "edl_gradient": edl_grads or None,
-            "aux_state": jax.tree_util.tree_map(np.asarray, aux_state)
-            if aux_state
-            else None,
+            # device_get batches d2h copies; per-leaf np.asarray costs a
+            # round-trip per leaf over a high-latency device link
+            "aux_state": jax.device_get(aux_state) if aux_state else None,
         }
         if flat:
             req["gradient_flat"] = self._to_wire_dtype(grads)
@@ -465,6 +470,26 @@ class Worker:
         model off-device. optax transforms are elementwise, so running
         them on the flat vector is identical math to the tree form."""
         assert self._use_flat(), "local mode requires flat transport"
+        step = self._local_step_core()
+
+        if self._mesh is None or self._mesh.size <= 1:
+            return jax.jit(step, donate_argnums=(0, 1))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self._mesh, P())
+        batch = NamedSharding(self._mesh, P(self._mesh.axis_names[0]))
+        return jax.jit(
+            step,
+            in_shardings=(repl, repl, repl, batch, batch),
+            out_shardings=repl,
+            donate_argnums=(0, 1),
+        )
+
+    def _local_step_core(self):
+        """The single-minibatch local update:
+        (flat, opt_state, aux, f, l) -> (flat', opt_state', aux', loss).
+        One definition shared by the per-step jit and the window scan,
+        so the two paths cannot drift apart mathematically."""
         spec = self._spec
         tx = spec.optimizer()
         unravel = self._unravel
@@ -482,24 +507,17 @@ class Worker:
                 flat
             )
             updates, opt_state = tx.update(grad, opt_state, flat)
-            return flat + updates, opt_state, new_aux, loss
+            return flat + updates, opt_state, new_aux if new_aux else aux, loss
 
-        if self._mesh is None or self._mesh.size <= 1:
-            return jax.jit(step, donate_argnums=(0, 1))
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        return step
 
-        repl = NamedSharding(self._mesh, P())
-        batch = NamedSharding(self._mesh, P(self._mesh.axis_names[0]))
-        return jax.jit(
-            step,
-            in_shardings=(repl, repl, repl, batch, batch),
-            out_shardings=repl,
-            donate_argnums=(0, 1),
-        )
-
-    def _local_minibatch(self, features, labels, task: Task):
+    def _ensure_local_ready(self, features, task: Task):
+        """Window-boundary preamble shared by the per-step and scanned
+        local paths: absorb any in-flight sync, (re)pull or lazily init
+        the model, and (re)initialize the on-device optimizer state."""
         if self._pending_steps == 0:
-            self._join_sync()  # absorb any async sync before rebasing
+            with self.timers.phase("sync_wait"):
+                self._join_sync()  # absorb any async sync before rebasing
         if self._pending_steps == 0 and (
             not self._fresh or self._version < task.model_version
         ):
@@ -508,21 +526,112 @@ class Worker:
                 self.report_variable()
                 self.pull_model()
             self._opt_state = None  # params swapped: restart opt state
-        if self._local_step_fn is None:
-            self._local_step_fn = self._build_local_step()
         if self._opt_state is None:
             tx = self._spec.optimizer()
             self._opt_state = tx.init(self._flat)
             self._base_flat = jnp.copy(self._flat)
             self._base_version = self._version
+
+    def _local_minibatch(self, features, labels, task: Task):
+        self._ensure_local_ready(features, task)
+        if self._local_step_fn is None:
+            self._local_step_fn = self._build_local_step()
         self._flat, self._opt_state, new_aux, loss = self._local_step_fn(
             self._flat, self._opt_state, self._aux, features, labels
         )
         self._aux = new_aux or self._aux
         self._pending_steps += 1
         if self._pending_steps >= self._local_updates:
-            self._sync_local_updates()
+            # async: the delta d2h + RPC ride a background thread while
+            # the device starts the next window (double-buffering)
+            self._sync_local_updates(blocking=False)
         return loss  # device array; resolve lazily so steps pipeline
+
+    def _build_local_window_fn(self):
+        """Whole-window fused step: `lax.scan` over W stacked minibatches
+        runs W loss+grad+optimizer updates in ONE device call. This is
+        the TPU-first shape of the local-update loop — W-fold fewer
+        host->device dispatches and one bulk feature transfer per
+        window instead of per minibatch; math is identical to W calls
+        of the per-step path (same carry: flat params, opt state, aux)."""
+        assert self._use_flat(), "local mode requires flat transport"
+        step = self._local_step_core()
+
+        def window(flat, opt_state, aux, features, labels):
+            def body(carry, xs):
+                flat, opt_state, aux = carry
+                f, l = xs
+                flat, opt_state, aux, loss = step(flat, opt_state, aux, f, l)
+                return (flat, opt_state, aux), loss
+
+            (flat, opt_state, aux), losses = jax.lax.scan(
+                body, (flat, opt_state, aux), (features, labels)
+            )
+            return flat, opt_state, aux, losses[-1]
+
+        if self._mesh is None or self._mesh.size <= 1:
+            return jax.jit(window, donate_argnums=(0, 1))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self._mesh, P())
+        # stacked batches are [W, B, ...]: shard the B axis over dp
+        batch = NamedSharding(self._mesh, P(None, self._mesh.axis_names[0]))
+        return jax.jit(
+            window,
+            in_shardings=(repl, repl, repl, batch, batch),
+            out_shardings=repl,
+            donate_argnums=(0, 1),
+        )
+
+    def _local_window(self, features, labels, task: Task):
+        """features/labels stacked [W, B, ...] with W == local_updates."""
+        first = jax.tree_util.tree_map(lambda a: a[0], features)
+        self._ensure_local_ready(first, task)
+        if self._local_window_fn is None:
+            self._local_window_fn = self._build_local_window_fn()
+        self._flat, self._opt_state, new_aux, loss = self._local_window_fn(
+            self._flat, self._opt_state, self._aux, features, labels
+        )
+        self._aux = new_aux or self._aux
+        self._pending_steps += self._local_updates
+        self._sync_local_updates(blocking=False)
+        return loss
+
+    def _run_local_windows(self, batches, task: Task):
+        """Group parsed minibatches into local-update windows and run
+        each as one scanned device call; ragged tails (short windows or
+        a short final batch) fall back to the per-step path."""
+        W = self._local_updates
+        buf = []
+        loss = None
+        done = False
+        while not done:
+            with self.timers.phase("get_batch"):
+                batch = next(batches, None)
+            if batch is None:
+                done = True
+            else:
+                buf.append(batch)
+            if buf and (done or len(buf) == W):
+                with self.timers.phase("compute"):
+                    n0 = len(jax.tree_util.tree_leaves(buf[0][0])[0])
+                    uniform = all(
+                        len(jax.tree_util.tree_leaves(f)[0]) == n0
+                        for f, _ in buf
+                    )
+                    if len(buf) == W and uniform:
+                        feats = jax.tree_util.tree_map(
+                            lambda *xs: np.stack(xs), *[b[0] for b in buf]
+                        )
+                        labs = jax.tree_util.tree_map(
+                            lambda *xs: np.stack(xs), *[b[1] for b in buf]
+                        )
+                        loss = self._local_window(feats, labs, task)
+                    else:
+                        for f, l in buf:
+                            loss = self._local_minibatch(f, l, task)
+                buf = []
+        return loss
 
     def _sync_local_updates(self, blocking: bool = True):
         """Push the cumulative delta: one d2h + one RPC per window.
@@ -540,13 +649,17 @@ class Worker:
         delta_dev = self._flat - self._base_flat  # own buffer, thread-safe
         steps = self._pending_steps
         base_version = self._base_version
-        aux_host = (
-            jax.tree_util.tree_map(np.asarray, self._aux) if self._aux else None
-        )
+        aux_dev = self._aux  # device refs; materialized in the thread
         self._base_flat = jnp.copy(self._flat)
         self._pending_steps = 0
 
         def do_sync():
+            # jax.device_get batches the copies (one async round) —
+            # per-leaf np.asarray costs a device round-trip per leaf,
+            # which over a high-latency host<->TPU link dwarfs the
+            # transfer itself. Materializing here also keeps the d2h
+            # wait off the main thread's dispatch path entirely.
+            aux_host = jax.device_get(aux_dev) if aux_dev else None
             resp = self._master.call(
                 "ReportLocalUpdate",
                 {
@@ -650,7 +763,11 @@ class Worker:
         the response piggybacks the updated model, so no separate pull."""
         for _ in range(MAX_MINIBATCH_RETRY_NUM):
             if not self._fresh or self._version < task.model_version:
-                if not self.pull_model(max(self._version, task.model_version)):
+                with self.timers.phase("get_model"):
+                    pulled = self.pull_model(
+                        max(self._version, task.model_version)
+                    )
+                if not pulled:
                     # master uninitialized: init from our side (lazy PS
                     # init, reference worker.py:278-282, servicer.py:299-303)
                     embs = self._prepare_embeddings(features)
@@ -676,12 +793,13 @@ class Worker:
                 for name in gbets
             }
             flat = self._use_flat()
-            resp = self.report_gradient(
-                np.asarray(gparams) if flat else gparams,
-                edl_grads,
-                new_aux,
-                flat=flat,
-            )
+            with self.timers.phase("report_gradient"):
+                resp = self.report_gradient(
+                    np.asarray(gparams) if flat else gparams,
+                    edl_grads,
+                    new_aux,
+                    flat=flat,
+                )
             self._absorb_report_response(resp)
             if resp["accepted"]:
                 return float(loss)
@@ -721,15 +839,28 @@ class Worker:
         """Returns True if the task's result report was handled here
         (deferred behind the covering sync) rather than by `run()`."""
         reader = self._readers.get(task.shard_file_name)
-        records = list(reader.read_range(task.start, task.end))
+        with self.timers.phase("read_records"):
+            records = list(reader.read_range(task.start, task.end))
         chunks = iter_minibatches(records, self._minibatch_size)
-        for features, labels in PrefetchParser(
-            chunks, lambda c: self._parse(c, Mode.TRAINING)
-        ):
-            if self._local_updates:
-                loss = self._local_minibatch(features, labels, task)
-            else:
-                loss = self._process_minibatch(features, labels, task)
+        batches = iter(
+            PrefetchParser(chunks, lambda c: self._parse(c, Mode.TRAINING))
+        )
+        if self._local_updates > 1:
+            loss = self._run_local_windows(batches, task)
+        else:
+            loss = None
+            while True:
+                with self.timers.phase("get_batch"):
+                    batch = next(batches, None)
+                if batch is None:
+                    break
+                features, labels = batch
+                with self.timers.phase("compute"):
+                    if self._local_updates:
+                        loss = self._local_minibatch(features, labels, task)
+                    else:
+                        loss = self._process_minibatch(features, labels, task)
+        self.last_loss = float(loss)
         deferred = False
         if self._local_updates:
             # async sync at the task boundary; the task's result report
@@ -741,11 +872,12 @@ class Worker:
             deferred = True
             self._sync_local_updates(blocking=False)
         logger.info(
-            "Worker %d task %d done (last loss %.4f, v%d)",
+            "Worker %d task %d done (last loss %.4f, v%d) [%s]",
             self._id,
             task.task_id,
             float(loss),
             self._version,
+            self.timers.summary(),
         )
         return deferred
 
