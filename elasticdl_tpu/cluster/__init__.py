@@ -1,0 +1,18 @@
+"""Cluster substrate: pod lifecycle backends + k8s mini-DSLs.
+
+The reference binds elasticity directly to the Kubernetes API
+(elasticdl/python/common/k8s_client.py). Here the pod lifecycle is an
+interface (`PodBackend`) with two implementations: `ProcessBackend`
+(local subprocess workers — hermetic, testable, and the natural shape
+for single-host TPU-VM jobs) and `K8sBackend` (pods via the kubernetes
+client, import-gated). The `WorkerManager` is backend-agnostic, so the
+preemption/recovery logic is exercised by real process kills in unit
+tests instead of requiring a live cluster (SURVEY §4.4).
+"""
+
+from elasticdl_tpu.cluster.pod_backend import (  # noqa: F401
+    PodBackend,
+    PodEvent,
+    PodPhase,
+    ProcessBackend,
+)

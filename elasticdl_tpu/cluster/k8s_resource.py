@@ -1,0 +1,52 @@
+"""Resource-string DSL: ``"cpu=1,memory=4096Mi,tpu=8"``.
+
+Re-design of the reference parser (elasticdl/python/common/k8s_resource.py:38-78):
+same comma string surface, but the accelerator alias maps to TPU
+(``google.com/tpu``) instead of ``nvidia.com/gpu``, with ``gpu`` kept
+for mixed fleets.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+_ALIASES = {
+    "tpu": "google.com/tpu",
+    "gpu": "nvidia.com/gpu",
+}
+
+_MEMORY_RE = re.compile(r"^\d+(\.\d+)?(e\d+)?(Ei|Pi|Ti|Gi|Mi|Ki|E|P|T|G|M|K)?$")
+_CPU_RE = re.compile(r"^\d+(\.\d+)?m?$|^\d+m$")
+_COUNT_RE = re.compile(r"^\d+$")
+
+
+def parse(resource_str: str) -> Dict[str, str]:
+    """-> {k8s resource name: quantity}; validates formats."""
+    out: Dict[str, str] = {}
+    if not resource_str:
+        return out
+    for item in resource_str.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(f"invalid resource entry {item!r}: expected k=v")
+        k, v = (s.strip() for s in item.split("=", 1))
+        kl = k.lower()
+        if kl in ("memory", "ephemeral-storage"):
+            if not _MEMORY_RE.match(v):
+                raise ValueError(f"invalid {kl} quantity {v!r}")
+        elif kl == "cpu":
+            if not _CPU_RE.match(v):
+                raise ValueError(f"invalid cpu quantity {v!r}")
+        elif kl in _ALIASES:
+            if not _COUNT_RE.match(v):
+                raise ValueError(f"{kl} count must be an integer, got {v!r}")
+            kl = _ALIASES[kl]
+        elif "/" not in k:
+            raise ValueError(f"unknown resource {k!r}")
+        else:
+            kl = k  # fully-qualified custom resource, pass through
+        out[kl] = v
+    return out

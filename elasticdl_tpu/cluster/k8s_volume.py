@@ -1,0 +1,32 @@
+"""Volume-string DSL: ``"claim_name=c1,mount_path=/data"``.
+
+Mirror of the reference parser (elasticdl/python/common/k8s_volume.py:4-31).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+_KEYS = {"claim_name", "mount_path"}
+
+
+def parse(volume_str: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    if not volume_str:
+        return out
+    for item in volume_str.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(f"invalid volume entry {item!r}: expected k=v")
+        k, v = (s.strip() for s in item.split("=", 1))
+        if k not in _KEYS:
+            raise ValueError(
+                f"unknown volume key {k!r}; supported: {sorted(_KEYS)}"
+            )
+        out[k] = v
+    missing = _KEYS - out.keys()
+    if missing:
+        raise ValueError(f"volume spec missing keys: {sorted(missing)}")
+    return out

@@ -1,0 +1,216 @@
+"""Layered CLI argument sets — the inter-process config protocol.
+
+Re-design of the reference's flag system (elasticdl/python/common/args.py:45-296,
+master/args.py:41-64, worker/main.py:10-83): shared model-spec flags are
+defined once and composed into the master and worker parsers, and the
+master *forwards* the model-spec subset to workers as command-line args
+(reference master/main.py:229-255) — the flag namespace is the config
+protocol between processes, so worker flags must stay a subset of
+master flags by construction (`worker_forward_args`).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import List
+
+
+def pos_int(value: str) -> int:
+    v = int(value)
+    if v <= 0:
+        raise argparse.ArgumentTypeError(f"must be > 0, got {value}")
+    return v
+
+
+def non_neg_int(value: str) -> int:
+    v = int(value)
+    if v < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {value}")
+    return v
+
+
+def parse_envs(env_str: str) -> dict:
+    """``"k=v,k2=v2"`` -> dict (reference: common/args.py:17-42)."""
+    out = {}
+    if not env_str:
+        return out
+    for kv in env_str.split(","):
+        if not kv.strip():
+            continue
+        k, _, v = kv.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def add_model_spec_args(parser: argparse.ArgumentParser):
+    """Flags describing the user model — shared by master and worker
+    and forwarded master->worker verbatim (reference: common/args.py:45-174)."""
+    parser.add_argument(
+        "--model_zoo", required=True,
+        help="directory containing the model-zoo modules",
+    )
+    parser.add_argument(
+        "--model_def", required=True,
+        help='"file.symbol" of the model factory inside --model_zoo, '
+        'e.g. "mnist_functional_api.custom_model"',
+    )
+    parser.add_argument("--model_params", default="", help='"k=v,k2=v2" ctor params')
+    parser.add_argument("--dataset_fn", default="dataset_fn")
+    parser.add_argument("--loss", default="loss")
+    parser.add_argument("--optimizer", default="optimizer")
+    parser.add_argument("--eval_metrics_fn", default="eval_metrics_fn")
+    parser.add_argument(
+        "--prediction_outputs_processor", default="PredictionOutputsProcessor"
+    )
+    parser.add_argument("--minibatch_size", type=pos_int, required=True)
+    parser.add_argument(
+        "--local_updates", type=non_neg_int, default=0,
+        help="N>0: on-device optimizer with one delta sync per N steps "
+        "(SSP/local-SGD); 0: per-step sync SGD via the PS",
+    )
+    parser.add_argument(
+        "--transport_dtype", default="float32", choices=("float32", "bfloat16"),
+        help="wire dtype for gradients/deltas",
+    )
+    parser.add_argument("--log_level", default="INFO")
+
+
+def add_master_args(parser: argparse.ArgumentParser):
+    """Master-only flags (reference: master/args.py:12-35 +
+    common/args.py train params :177-270)."""
+    parser.add_argument("--port", type=non_neg_int, default=0)
+    parser.add_argument("--job_name", default="elasticdl-job")
+    parser.add_argument(
+        "--training_data_dir", default="",
+        help="RecordIO file or directory of shards for training",
+    )
+    parser.add_argument("--evaluation_data_dir", default="")
+    parser.add_argument("--prediction_data_dir", default="")
+    parser.add_argument("--records_per_task", type=pos_int, default=4096)
+    parser.add_argument("--num_epochs", type=pos_int, default=1)
+    parser.add_argument("--grads_to_wait", type=pos_int, default=2)
+    parser.add_argument("--use_async", action="store_true")
+    parser.add_argument("--lr_staleness_modulation", action="store_true")
+    parser.add_argument("--staleness_window", type=non_neg_int, default=0)
+    parser.add_argument("--eval_steps", type=non_neg_int, default=0)
+    parser.add_argument("--eval_start_delay_secs", type=float, default=0.0)
+    parser.add_argument("--eval_throttle_secs", type=float, default=0.0)
+    parser.add_argument("--checkpoint_dir", default="")
+    parser.add_argument("--checkpoint_steps", type=non_neg_int, default=0)
+    parser.add_argument("--keep_checkpoint_max", type=non_neg_int, default=0)
+    parser.add_argument(
+        "--checkpoint_filename_for_init", default="",
+        help="boot the PS from this checkpoint (required for "
+        "evaluate/predict jobs, reference master/args.py:53-64)",
+    )
+    parser.add_argument(
+        "--output", default="",
+        help="save the final model here when the job finishes",
+    )
+    # elasticity / cluster
+    parser.add_argument("--num_workers", type=pos_int, default=1)
+    parser.add_argument(
+        "--worker_backend", default="process", choices=("process", "k8s"),
+        help="process: local subprocess workers (hermetic); "
+        "k8s: pods via the kubernetes API",
+    )
+    parser.add_argument(
+        "--max_worker_relaunches", type=non_neg_int, default=10,
+        help="total replacement workers to launch before giving up",
+    )
+    parser.add_argument("--worker_image", default="")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument(
+        "--worker_resource_request", default="cpu=1,memory=2048Mi",
+        help='k8s resource DSL, e.g. "cpu=1,memory=4096Mi,tpu=1"',
+    )
+    parser.add_argument("--worker_resource_limit", default="")
+    parser.add_argument("--worker_pod_priority", default="")
+    parser.add_argument(
+        "--volume", default="",
+        help='k8s volume DSL: "claim_name=...,mount_path=..."',
+    )
+    parser.add_argument("--envs", default="", help='extra worker env "k=v,..."')
+    parser.add_argument(
+        "--cluster_spec", default="",
+        help="python file providing with_pod(pod) for on-prem mutation",
+    )
+
+
+def add_worker_args(parser: argparse.ArgumentParser):
+    """Worker-process flags (reference: worker/main.py:10-83)."""
+    parser.add_argument("--worker_id", type=non_neg_int, required=True)
+    parser.add_argument("--master_addr", required=True)
+
+
+def master_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="elasticdl_tpu.master", description="ElasticDL-TPU master"
+    )
+    add_model_spec_args(p)
+    add_master_args(p)
+    return p
+
+
+def worker_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="elasticdl_tpu.worker", description="ElasticDL-TPU worker"
+    )
+    add_model_spec_args(p)
+    add_worker_args(p)
+    return p
+
+
+def validate_master_args(args) -> str:
+    """Job-type inference + combination checks (reference:
+    master/main.py:111-136, master/args.py:41-64). Returns the job type."""
+    from elasticdl_tpu.common.constants import JobType
+
+    if args.prediction_data_dir:
+        if args.training_data_dir or args.evaluation_data_dir:
+            raise ValueError(
+                "prediction_data_dir is exclusive of training/evaluation dirs"
+            )
+        if not args.checkpoint_filename_for_init:
+            raise ValueError(
+                "prediction jobs require --checkpoint_filename_for_init"
+            )
+        return JobType.PREDICTION_ONLY
+    if args.training_data_dir and args.evaluation_data_dir:
+        return JobType.TRAINING_WITH_EVALUATION
+    if args.training_data_dir:
+        return JobType.TRAINING_ONLY
+    if args.evaluation_data_dir:
+        if not args.checkpoint_filename_for_init:
+            raise ValueError(
+                "evaluation jobs require --checkpoint_filename_for_init"
+            )
+        return JobType.EVALUATION_ONLY
+    raise ValueError("one of training/evaluation/prediction data dirs required")
+
+
+def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:
+    """The model-spec flag subset a master forwards to each worker
+    (reference: master/main.py:229-255)."""
+    argv = [
+        "--worker_id", str(worker_id),
+        "--master_addr", master_addr,
+        "--model_zoo", args.model_zoo,
+        "--model_def", args.model_def,
+        "--minibatch_size", str(args.minibatch_size),
+        "--local_updates", str(args.local_updates),
+        "--transport_dtype", args.transport_dtype,
+        "--log_level", args.log_level,
+    ]
+    for flag in (
+        "model_params",
+        "dataset_fn",
+        "loss",
+        "optimizer",
+        "eval_metrics_fn",
+        "prediction_outputs_processor",
+    ):
+        value = getattr(args, flag)
+        if value:
+            argv += [f"--{flag}", value]
+    return argv

@@ -14,6 +14,12 @@ GRPC_OPTIONS = [
 SERVICE_NAME = "elasticdl_tpu.Master"
 
 
+# Process exit code for "job completed but with dropped poison tasks":
+# deliberate partial-data completion, distinct from a crash — the
+# WorkerManager must NOT relaunch a worker that exits with it.
+EXIT_CODE_JOB_FAILED = 2
+
+
 class WorkerManagerStatus(object):
     PENDING = "Pending"
     RUNNING = "Running"

@@ -129,6 +129,15 @@ class EvaluationService:
         if version // self._eval_steps > prev // self._eval_steps:
             self.add_evaluation_task()
 
+    def start_standalone_job(self, version: int, total_tasks: int):
+        """Evaluation-only jobs (reference master/main.py evaluate
+        path): the dispatcher already holds version-pinned EVALUATION
+        tasks; register the accumulating job so metrics aggregate and
+        `has_pending` gates worker exit."""
+        with self._lock:
+            self._eval_job = _EvaluationJob(version, total_tasks=total_tasks)
+            self._last_eval_version = version
+
     def add_evaluation_task(self):
         """Pin the current version + create eval tasks (reference: :131-148)."""
         with self._lock:

@@ -185,7 +185,16 @@ class MasterServicer:
                     "params": jax.tree_util.tree_map(np.copy, self._params),
                     "aux": jax.tree_util.tree_map(np.copy, self._aux),
                 }
-        # FIXED
+        # FIXED: serve the exact version — from live PS state when it
+        # still matches (standalone eval jobs never train past it),
+        # else from the eval-snapshot store / durable checkpoints
+        with self._lock:
+            if version == self._version and self._params is not None:
+                return {
+                    "version": self._version,
+                    "params": jax.tree_util.tree_map(np.copy, self._params),
+                    "aux": jax.tree_util.tree_map(np.copy, self._aux),
+                }
         if self._checkpoint_service is None:
             raise ValueError("FIXED model pull requires a checkpoint service")
         model = self._checkpoint_service.get_eval_model(version)

@@ -34,6 +34,7 @@ class TaskDispatcher:
         records_per_task: int,
         num_epochs: int,
         max_task_retries: int = 10,
+        eval_model_version: int = -1,
     ):
         self._lock = threading.Lock()
         # Unlike the reference (which requeues failed tasks forever,
@@ -59,7 +60,11 @@ class TaskDispatcher:
             logger.info("Starting epoch %d", self._epoch)
             self._create_training_tasks()
         elif self._evaluation_shards:
-            self._create_tasks_no_lock(self._evaluation_shards, TaskType.EVALUATION)
+            # standalone evaluation job: tasks pinned to the version the
+            # master booted from (its init checkpoint)
+            self._create_tasks_no_lock(
+                self._evaluation_shards, TaskType.EVALUATION, eval_model_version
+            )
         elif self._prediction_shards:
             self._create_tasks_no_lock(self._prediction_shards, TaskType.PREDICTION)
 
@@ -189,6 +194,13 @@ class TaskDispatcher:
             if self._training_shards and self._epoch < self._num_epochs - 1:
                 return False
             return not self._todo and not self._doing
+
+    def pending_count(self, task_type: Optional[str] = None) -> int:
+        """Number of queued (todo) tasks, optionally of one type."""
+        with self._lock:
+            if task_type is None:
+                return len(self._todo)
+            return sum(1 for t in self._todo if t.type == task_type)
 
     def has_failed_tasks(self) -> bool:
         """True when any task was dropped after exhausting its retries —

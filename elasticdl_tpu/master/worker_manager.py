@@ -1,0 +1,152 @@
+"""WorkerManager: the elasticity controller.
+
+Re-design of the reference's `WorkerManager`
+(elasticdl/python/master/k8s_worker_manager.py:9-145) over the
+backend-agnostic pod interface:
+
+- `start_workers()` launches N workers with incrementing ids (:61-88);
+- on a DELETED/FAILED event: `task_dispatcher.recover_tasks(worker_id)`
+  requeues the dead worker's in-flight shards and a replacement worker
+  is launched with a FRESH id (:134-145) — fresh ids keep the
+  dispatcher's doing-map unambiguous across generations;
+- SUCCEEDED workers are not relaunched;
+- a relaunch budget bounds crash loops (the reference relaunches
+  forever; a poison image would flap pods indefinitely);
+- `stop_relaunch_and_remove_workers()` for teardown (:100-104).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.cluster.pod_backend import PodBackend, PodEvent, PodPhase
+from elasticdl_tpu.common.constants import EXIT_CODE_JOB_FAILED
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+_TERMINAL = (PodPhase.SUCCEEDED, PodPhase.FAILED, PodPhase.DELETED)
+
+
+class WorkerManager:
+    def __init__(
+        self,
+        backend: PodBackend,
+        task_dispatcher,
+        num_workers: int,
+        worker_argv_fn: Callable[[int], List[str]],
+        envs: Optional[Dict[str, str]] = None,
+        max_relaunches: int = 10,
+    ):
+        self._backend = backend
+        self._task_d = task_dispatcher
+        self._num_workers = num_workers
+        self._argv_fn = worker_argv_fn
+        self._envs = envs or {}
+        self._max_relaunches = max_relaunches
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._relaunches = 0
+        self._relaunch = True
+        self._phases: Dict[int, str] = {}
+        self._live = 0
+        backend.set_event_callback(self._event_cb)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start_workers(self):
+        """reference: k8s_worker_manager.py:86-88."""
+        for _ in range(self._num_workers):
+            self._start_one()
+
+    def _start_one(self, live_reserved: bool = False):
+        with self._lock:
+            worker_id = self._next_id
+            self._next_id += 1
+            self._phases[worker_id] = PodPhase.PENDING
+            if not live_reserved:
+                self._live += 1
+        self._backend.start_worker(
+            worker_id, self._argv_fn(worker_id), self._envs
+        )
+
+    def stop_relaunch_and_remove_workers(self):
+        """reference: k8s_worker_manager.py:100-104."""
+        with self._lock:
+            self._relaunch = False
+            ids = [
+                wid
+                for wid, phase in self._phases.items()
+                if phase
+                in (PodPhase.PENDING, PodPhase.RUNNING)
+            ]
+        for wid in ids:
+            self._backend.delete_worker(wid)
+
+    # -- elasticity ---------------------------------------------------------
+
+    def _event_cb(self, event: PodEvent):
+        """Pod phase bookkeeping + recovery
+        (reference: k8s_worker_manager.py:110-145)."""
+        done = event.phase in _TERMINAL
+        # "completed with dropped poison tasks": a deliberate terminal
+        # state — relaunching would just exit 2 again, churning the
+        # relaunch budget at job end
+        completed = event.phase == PodPhase.SUCCEEDED or (
+            event.exit_code == EXIT_CODE_JOB_FAILED
+        )
+        with self._lock:
+            # dedupe: the k8s watch re-delivers existing pod states on
+            # every stream restart; a worker already terminal must not
+            # re-decrement live counts or trigger another relaunch (and
+            # a stale RUNNING replay must not resurrect it)
+            if self._phases.get(event.worker_id) in _TERMINAL:
+                return
+            self._phases[event.worker_id] = event.phase
+            if done:
+                self._live = max(0, self._live - 1)
+            should_relaunch = (
+                done
+                and not completed
+                and self._relaunch
+                and self._relaunches < self._max_relaunches
+            )
+            if should_relaunch:
+                self._relaunches += 1
+                # reserve the replacement's live slot HERE so
+                # all_exited() can never observe live==0 while the
+                # relaunch is in flight
+                self._live += 1
+        if not done:
+            return
+        if event.phase != PodPhase.SUCCEEDED:
+            # the dead worker's in-flight shards go back to todo; its
+            # stale gradients are already harmless (version check)
+            logger.info(
+                "Worker %d %s: recovering tasks%s",
+                event.worker_id,
+                event.phase,
+                ", relaunching" if should_relaunch else "",
+            )
+            self._task_d.recover_tasks(event.worker_id)
+        if should_relaunch:
+            self._start_one(live_reserved=True)
+
+    # -- introspection ------------------------------------------------------
+
+    def live_workers(self) -> int:
+        with self._lock:
+            return self._live
+
+    def phases(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._phases)
+
+    def relaunches(self) -> int:
+        with self._lock:
+            return self._relaunches
+
+    def all_exited(self) -> bool:
+        with self._lock:
+            return self._live == 0 and bool(self._phases)

@@ -1,0 +1,75 @@
+"""Worker process entrypoint.
+
+Re-design of the reference worker main
+(elasticdl/python/worker/main.py:86-117): parse flags, open the gRPC
+channel to the master, resolve the model spec from the model zoo, run
+the task loop, exit 0 on clean completion.
+
+Exit codes: 0 = job finished cleanly; 1 = crash;
+EXIT_CODE_JOB_FAILED (2) = job finished but the master reported failed
+(dropped poison) tasks — partial data must not look like success to
+the pod phase / process supervisor, yet it must not be relaunched as a
+crash either.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from elasticdl_tpu.api.model_spec import get_model_spec
+from elasticdl_tpu.common.args import worker_parser
+from elasticdl_tpu.common.constants import EXIT_CODE_JOB_FAILED
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+
+def main(argv=None) -> int:
+    args = worker_parser().parse_args(argv)
+
+    import logging
+    import os
+
+    logging.getLogger().setLevel(args.log_level.upper())
+
+    # the image's sitecustomize force-registers the TPU platform over
+    # JAX_PLATFORMS; honor an explicit cpu request (hermetic tests)
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from elasticdl_tpu.rpc.client import RpcClient
+    from elasticdl_tpu.worker.worker import Worker
+
+    spec = get_model_spec(
+        model_zoo=args.model_zoo,
+        model_def=args.model_def,
+        model_params=args.model_params,
+        dataset_fn=args.dataset_fn,
+        loss=args.loss,
+        optimizer=args.optimizer,
+        eval_metrics_fn=args.eval_metrics_fn,
+        prediction_outputs_processor=args.prediction_outputs_processor,
+    )
+
+    client = RpcClient(args.master_addr)
+    client.wait_ready(timeout=60)
+    worker = Worker(
+        args.worker_id,
+        client,
+        spec,
+        minibatch_size=args.minibatch_size,
+        local_updates=args.local_updates,
+        transport_dtype=args.transport_dtype,
+    )
+    try:
+        clean = worker.run()
+    finally:
+        worker.close()
+        client.close()
+    return 0 if clean else EXIT_CODE_JOB_FAILED
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""Cluster substrate tests: DSL parsers, pod manifests, WorkerManager
+elasticity logic against a fake backend (mirrors the reference's
+k8s_resource_test.py / k8s_volume_test.py / k8s_worker_manager_test.py
+— the latter's event logic here runs clusterless)."""
+
+import pytest
+
+from elasticdl_tpu.cluster import k8s_resource, k8s_volume
+from elasticdl_tpu.cluster.k8s_backend import (
+    build_tensorboard_service_manifest,
+    build_worker_pod_manifest,
+    worker_pod_name,
+)
+from elasticdl_tpu.cluster.pod_backend import PodBackend, PodEvent, PodPhase
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.master.worker_manager import WorkerManager
+
+
+# -- resource DSL -----------------------------------------------------------
+
+
+def test_resource_parse():
+    out = k8s_resource.parse("cpu=2,memory=4096Mi,tpu=8")
+    assert out == {"cpu": "2", "memory": "4096Mi", "google.com/tpu": "8"}
+
+
+def test_resource_parse_gpu_alias_and_millicpu():
+    out = k8s_resource.parse("cpu=250m,gpu=1,ephemeral-storage=10Gi")
+    assert out["nvidia.com/gpu"] == "1"
+    assert out["cpu"] == "250m"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["cpu=abc", "memory=4096Zi", "tpu=half", "bogus=1", "cpu"],
+)
+def test_resource_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        k8s_resource.parse(bad)
+
+
+def test_resource_custom_qualified_passthrough():
+    out = k8s_resource.parse("example.com/fpga=2")
+    assert out == {"example.com/fpga": "2"}
+
+
+# -- volume DSL -------------------------------------------------------------
+
+
+def test_volume_parse():
+    out = k8s_volume.parse("claim_name=c1,mount_path=/data")
+    assert out == {"claim_name": "c1", "mount_path": "/data"}
+
+
+@pytest.mark.parametrize("bad", ["claim_name=c1", "bogus=1,mount_path=/p"])
+def test_volume_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        k8s_volume.parse(bad)
+
+
+# -- pod manifests ----------------------------------------------------------
+
+
+def test_worker_pod_manifest():
+    pod = build_worker_pod_manifest(
+        "job1",
+        3,
+        "img:latest",
+        ["python", "-m", "elasticdl_tpu.worker.main"],
+        resource_request="cpu=1,memory=1024Mi",
+        pod_priority="low",
+        volume="claim_name=c1,mount_path=/data",
+        envs={"A": "b"},
+        owner_pod={"metadata": {"name": "elasticdl-job1-master", "uid": "u1"}},
+    )
+    assert pod["metadata"]["name"] == worker_pod_name("job1", 3) == (
+        "elasticdl-job1-worker-3"
+    )
+    labels = pod["metadata"]["labels"]
+    assert labels["elasticdl-job-name"] == "job1"
+    assert labels["elasticdl-replica-index"] == "3"
+    owner = pod["metadata"]["ownerReferences"][0]
+    assert owner["name"] == "elasticdl-job1-master" and owner["uid"] == "u1"
+    spec = pod["spec"]
+    assert spec["restartPolicy"] == "Never"
+    assert spec["priorityClassName"] == "low"
+    c = spec["containers"][0]
+    assert c["resources"]["requests"]["memory"] == "1024Mi"
+    assert c["volumeMounts"][0]["mountPath"] == "/data"
+    assert {"name": "A", "value": "b"} in c["env"]
+
+
+def test_tensorboard_service_manifest():
+    svc = build_tensorboard_service_manifest("job1")
+    assert svc["spec"]["selector"] == {"elasticdl-job-name": "job1"}
+    assert svc["spec"]["ports"][0]["port"] == 6006
+
+
+# -- WorkerManager elasticity over a fake backend ---------------------------
+
+
+class FakeBackend(PodBackend):
+    def __init__(self):
+        self.started = []  # (worker_id, argv)
+        self.deleted = []
+        self._cb = None
+
+    def set_event_callback(self, cb):
+        self._cb = cb
+
+    def start_worker(self, worker_id, argv, envs):
+        self.started.append((worker_id, list(argv)))
+
+    def delete_worker(self, worker_id):
+        self.deleted.append(worker_id)
+        self._cb(PodEvent(worker_id, PodPhase.DELETED))
+
+    def stop(self):
+        pass
+
+    def fire(self, worker_id, phase, exit_code=None):
+        self._cb(PodEvent(worker_id, phase, exit_code=exit_code))
+
+
+def _manager(num_workers=2, max_relaunches=10):
+    dispatcher = TaskDispatcher({"f": 64}, {}, {}, 16, 1)
+    backend = FakeBackend()
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=num_workers,
+        worker_argv_fn=lambda wid: ["--worker_id", str(wid)],
+        max_relaunches=max_relaunches,
+    )
+    return manager, backend, dispatcher
+
+
+def test_start_workers_incrementing_ids():
+    manager, backend, _ = _manager(num_workers=3)
+    manager.start_workers()
+    assert [wid for wid, _ in backend.started] == [0, 1, 2]
+    assert manager.live_workers() == 3
+
+
+def test_dead_worker_recovered_and_relaunched_with_fresh_id():
+    manager, backend, dispatcher = _manager(num_workers=2)
+    manager.start_workers()
+    # worker 0 takes two tasks then dies
+    t1 = dispatcher.get(0)
+    t2 = dispatcher.get(0)
+    assert t1 is not None and t2 is not None
+    before = dispatcher.pending_count()
+    backend.fire(0, PodPhase.DELETED)
+    # both in-flight tasks requeued
+    assert dispatcher.pending_count() == before + 2
+    # replacement launched with a FRESH id (not 0)
+    assert [wid for wid, _ in backend.started] == [0, 1, 2]
+    assert manager.relaunches() == 1
+    assert manager.live_workers() == 2
+
+
+def test_succeeded_worker_not_relaunched():
+    manager, backend, _ = _manager(num_workers=2)
+    manager.start_workers()
+    backend.fire(0, PodPhase.SUCCEEDED, exit_code=0)
+    assert len(backend.started) == 2
+    assert manager.live_workers() == 1
+
+
+def test_relaunch_budget_bounds_crash_loop():
+    manager, backend, _ = _manager(num_workers=1, max_relaunches=3)
+    manager.start_workers()
+    for _ in range(10):
+        # kill whatever was launched most recently
+        wid = backend.started[-1][0]
+        backend.fire(wid, PodPhase.FAILED, exit_code=1)
+    assert len(backend.started) == 1 + 3  # initial + budget
+    assert manager.all_exited()
+
+
+def test_stop_relaunch_suppresses_replacement():
+    manager, backend, _ = _manager(num_workers=2)
+    manager.start_workers()
+    manager.stop_relaunch_and_remove_workers()
+    assert sorted(backend.deleted) == [0, 1]
+    # deletes fired DELETED events; nothing relaunched
+    assert len(backend.started) == 2
+    assert manager.all_exited()
