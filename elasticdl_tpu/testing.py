@@ -47,12 +47,18 @@ def build_job(
     checkpoint_steps: int = 0,
     keep_checkpoint_max: int = 0,
     use_async: bool = False,
+    lr_staleness_modulation: bool = False,
     staleness_window: int = 0,
+    checkpoint_filename_for_init: str = "",
 ):
     """Wire a MasterServicer + services from a ModelSpec, exactly like
-    the real master boot (reference: master/main.py:138-223). Returns
+    the real master boot (reference: master/main.py:138-223), including
+    the public boot-from-checkpoint path (servicer.py:80-84). Returns
     (servicer, evaluation_service, checkpoint_service)."""
-    from elasticdl_tpu.master.checkpoint import CheckpointService
+    from elasticdl_tpu.master.checkpoint import (
+        CheckpointService,
+        load_model_file,
+    )
     from elasticdl_tpu.master.embedding_store import EmbeddingStore
     from elasticdl_tpu.master.evaluation_service import EvaluationService
     from elasticdl_tpu.master.ps_optimizer import PSOptimizer
@@ -63,6 +69,15 @@ def build_job(
     if spec.embedding_specs:
         store = EmbeddingStore()
         sparse_opt = SparseOptimizer(store, **(spec.sparse_optimizer or {}))
+
+    init_params = init_aux = None
+    init_version = 0
+    if checkpoint_filename_for_init:
+        model = load_model_file(checkpoint_filename_for_init)
+        init_params, init_aux = model.params, model.aux
+        init_version = model.version
+        if store is not None and model.embeddings:
+            store.restore(model.embeddings)
 
     ckpt = CheckpointService(
         checkpoint_dir=checkpoint_dir,
@@ -78,7 +93,11 @@ def build_job(
         checkpoint_service=ckpt,
         embedding_store=store,
         sparse_optimizer=sparse_opt,
+        init_params=init_params,
+        init_aux=init_aux,
+        init_version=init_version,
         use_async=use_async,
+        lr_staleness_modulation=lr_staleness_modulation,
         staleness_window=staleness_window,
     )
     eval_service = None

@@ -1,0 +1,107 @@
+"""Checkpoint durability: rotation, load_version, resume-from-checkpoint
+(the public --checkpoint_filename_for_init path), and the embedding
+snapshot round-trip (a capability the reference explicitly lacks —
+distributed_embedding_layer_design.md:425-428 admits Redis tables are
+not checkpointed)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from fixtures import linear_module  # noqa: E402
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module  # noqa: E402
+from elasticdl_tpu.master.checkpoint import (  # noqa: E402
+    CheckpointService,
+    load_model_file,
+    save_model_file,
+)
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher  # noqa: E402
+from elasticdl_tpu.testing import (  # noqa: E402
+    InProcessMaster,
+    build_job,
+    write_linear_records,
+)
+from elasticdl_tpu.worker.worker import Worker  # noqa: E402
+
+
+def _run_job(tmp_path, n=64, **job_kwargs):
+    path = str(tmp_path / "train.rio")
+    write_linear_records(path, n, noise=0.05)
+    dispatcher = TaskDispatcher({path: n}, {}, {}, 16, 1)
+    spec = spec_from_module(linear_module)
+    servicer, eval_service, ckpt = build_job(spec, dispatcher, **job_kwargs)
+    worker = Worker(0, InProcessMaster(servicer), spec, minibatch_size=16)
+    assert worker.run()
+    assert dispatcher.finished()
+    return spec, servicer, ckpt
+
+
+def test_rotation_keeps_last_k(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpts")
+    _, servicer, ckpt = _run_job(
+        tmp_path,
+        checkpoint_dir=ckpt_dir,
+        checkpoint_steps=1,
+        keep_checkpoint_max=2,
+    )
+    files = sorted(os.listdir(ckpt_dir))
+    assert len(files) == 2, files  # ring buffer pruned older snapshots
+    # the retained versions are loadable by exact version
+    versions = sorted(int(f.split("_v")[1].split(".")[0]) for f in files)
+    assert versions[-1] == servicer.version
+    model = ckpt.load_version(versions[0])
+    assert model is not None and model.version == versions[0]
+    # pruned versions are gone
+    assert ckpt.load_version(1) is None
+    assert ckpt.latest_path().endswith(f"model_v{servicer.version}.ckpt")
+
+
+def test_resume_from_checkpoint_continues_version(tmp_path):
+    spec, servicer, _ = _run_job(tmp_path)
+    v1 = servicer.version
+    ckpt_file = str(tmp_path / "resume.ckpt")
+    servicer.save_latest_checkpoint(ckpt_file)
+
+    # boot a NEW master from the file (public init path) and train more
+    path2 = str(tmp_path / "more.rio")
+    write_linear_records(path2, 32, seed=7, noise=0.05)
+    dispatcher2 = TaskDispatcher({path2: 32}, {}, {}, 16, 1)
+    servicer2, _, _ = build_job(
+        spec, dispatcher2, checkpoint_filename_for_init=ckpt_file
+    )
+    assert servicer2.version == v1
+    p1, _, _ = servicer.get_params_copy()
+    p2, _, _ = servicer2.get_params_copy()
+    np.testing.assert_allclose(
+        p1["Dense_0"]["kernel"], p2["Dense_0"]["kernel"]
+    )
+    worker = Worker(0, InProcessMaster(servicer2), spec, minibatch_size=16)
+    assert worker.run()
+    assert servicer2.version > v1  # training continued from the saved version
+
+
+def test_embedding_snapshot_roundtrip_via_file(tmp_path):
+    from elasticdl_tpu.master.embedding_store import EmbeddingStore
+
+    store = EmbeddingStore()
+    ids = np.asarray([1, 5, 9])
+    rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+    store.update("layer0", ids, rows)
+    path = str(tmp_path / "emb.ckpt")
+    save_model_file(
+        path,
+        {"w": np.ones(3, np.float32)},
+        7,
+        embeddings=store.snapshot(),
+    )
+    model = load_model_file(path)
+    store2 = EmbeddingStore()
+    store2.restore(model.embeddings)
+    values, unknown = store2.lookup("layer0", ids)
+    assert not len(unknown)
+    np.testing.assert_allclose(values, rows)
+    assert model.version == 7

@@ -130,21 +130,25 @@ def test_deepfm_edl_embedding_sparse_path(tmp_path):
 
 
 def test_prediction_job(tmp_path):
-    """train -> predict with the trained params, exercising the
-    prediction task type + PredictionOutputsProcessor sink."""
+    """train -> checkpoint -> predict booted from the checkpoint via
+    the PUBLIC init path (--checkpoint_filename_for_init semantics,
+    reference servicer.py:80-84), exercising the prediction task type +
+    PredictionOutputsProcessor sink."""
     servicer, _ = run_training_job(
         mnist_functional_api, _image_writer((28, 28, 1)), tmp_path
     )
-    params, aux, version = servicer.get_params_copy()
+    ckpt_file = str(tmp_path / "trained.ckpt")
+    servicer.save_latest_checkpoint(ckpt_file)
 
     pred = str(tmp_path / "pred.rio")
     rc.write_synthetic_image_records(pred, 8, (28, 28, 1), 10)
     dispatcher = TaskDispatcher({}, {}, {pred: 8}, 8, 1)
     spec = spec_from_module(mnist_functional_api)
-    servicer2, _, _ = build_job(spec, dispatcher)
-    servicer2._params = params
-    servicer2._aux = aux
-    servicer2._version = version
+    servicer2, _, _ = build_job(
+        spec, dispatcher, checkpoint_filename_for_init=ckpt_file
+    )
+    assert servicer2.model_initialized()
+    assert servicer2.version == servicer.version
     worker = Worker(0, InProcessMaster(servicer2), spec, minibatch_size=8)
     worker.run()
     assert dispatcher.finished()
