@@ -1,0 +1,5 @@
+"""Client plane (L6): CLI, job submit API, image builder.
+
+Reference: elasticdl/python/elasticdl/ — client.py:12-39 (CLI),
+api.py:11-227 (submit), image_builder.py:92-203 (image build).
+"""

@@ -1,0 +1,140 @@
+"""Job submit API: train / evaluate / predict.
+
+Re-design of the reference submit path
+(elasticdl/python/elasticdl/api.py:11-227): each verb resolves the job
+image (build or reuse), remaps user paths into the image, serializes
+the parsed flags back into master container args
+(`master_forward_args` — the flag namespace is the submit protocol),
+and either
+
+- **k8s**: builds the master pod manifest and creates it via the
+  apiserver (`create_master_pod`); everything else happens in-cluster —
+  the client exits (reference call stack SURVEY §3.1), or
+- **process**: runs the master locally as a subprocess — the hermetic
+  single-machine mode the reference exposes only through its docker
+  two-terminal walkthrough (elasticdl/README.md).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import subprocess
+import sys
+from typing import List, Optional
+
+from elasticdl_tpu.client import image_builder
+from elasticdl_tpu.cluster.k8s_backend import (
+    build_master_pod_manifest,
+    create_master_pod,
+    master_pod_name,
+)
+from elasticdl_tpu.common.args import (
+    master_forward_args,
+    parse_envs,
+    validate_master_args,
+)
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+MASTER_COMMAND = ["python", "-m", "elasticdl_tpu.master.main"]
+
+
+def train(args) -> int:
+    return _submit_job(args)
+
+
+def evaluate(args) -> int:
+    return _submit_job(args)
+
+
+def predict(args) -> int:
+    return _submit_job(args)
+
+
+def _resolve_image(args) -> str:
+    if args.image_name:
+        return args.image_name
+    if args.worker_backend != "k8s":
+        return ""  # local mode needs no image
+    if not args.docker_image_repository:
+        # a local-only tag is useless to cluster nodes; fail before
+        # spending a docker build on it
+        return ""
+    return image_builder.build_and_push_docker_image(
+        model_zoo=args.model_zoo,
+        base_image=args.image_base,
+        docker_image_repository=args.docker_image_repository,
+        push=args.push_image,
+        cluster_spec=args.cluster_spec,
+    )
+
+
+def _remap_into_image(args):
+    """User paths -> canonical in-image paths (reference: api.py:230-241)."""
+    import os
+
+    remapped = copy.copy(args)
+    remapped.model_zoo = image_builder.IMAGE_MODEL_ZOO
+    if args.cluster_spec:
+        remapped.cluster_spec = os.path.join(
+            image_builder.IMAGE_CLUSTER_SPEC_DIR,
+            os.path.basename(args.cluster_spec),
+        )
+    return remapped
+
+
+def build_master_manifest(args, image: str) -> dict:
+    """Assemble the master pod manifest from parsed client args —
+    pure, unit-testable (reference: api.py:205-223)."""
+    remapped = _remap_into_image(args)
+    if not remapped.worker_image:
+        remapped.worker_image = image
+    command = MASTER_COMMAND + master_forward_args(remapped)
+    return build_master_pod_manifest(
+        job_name=args.job_name,
+        image=image,
+        command=command,
+        namespace=args.namespace,
+        resource_request=args.master_resource_request,
+        resource_limit=args.master_resource_limit,
+        pod_priority=args.master_pod_priority,
+        volume=args.volume,
+        envs=parse_envs(args.envs),
+    )
+
+
+def _submit_job(args) -> int:
+    validate_master_args(args)  # fail client-side, not in the pod
+    if args.worker_backend == "k8s":
+        image = _resolve_image(args)
+        if not image:
+            raise ValueError(
+                "k8s jobs need an image: pass --image_name or "
+                "--docker_image_repository to build one"
+            )
+        manifest = build_master_manifest(args, image)
+        if args.dry_run:
+            print(json.dumps(manifest, indent=2))
+            return 0
+        create_master_pod(manifest, args.namespace, args.cluster_spec)
+        logger.info(
+            "Submitted master pod %s (namespace %s); the job now runs "
+            "in-cluster",
+            master_pod_name(args.job_name),
+            args.namespace,
+        )
+        return 0
+    # process backend: run the master here and wait for the job
+    argv = master_forward_args(args)
+    cmd = _local_master_command(argv)
+    if args.dry_run:
+        print(json.dumps({"command": cmd}, indent=2))
+        return 0
+    logger.info("Running local master: %s", " ".join(cmd))
+    return subprocess.run(cmd).returncode
+
+
+def _local_master_command(argv: List[str], python: Optional[str] = None) -> List[str]:
+    return [python or sys.executable, "-m", "elasticdl_tpu.master.main"] + argv

@@ -1,0 +1,48 @@
+"""The `elasticdl_tpu` CLI: train / evaluate / predict.
+
+Re-design of the reference CLI (elasticdl/python/elasticdl/client.py:12-39,
+console script setup.py:17-19): a verb dispatcher over the shared
+client parser; each verb forwards the full parsed flag set to the
+submit API.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from elasticdl_tpu.client import api
+from elasticdl_tpu.common.args import client_parser
+
+VERBS = {
+    "train": api.train,
+    "evaluate": api.evaluate,
+    "predict": api.predict,
+}
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(
+            "usage: elasticdl_tpu {train,evaluate,predict} [flags]\n"
+            "run `elasticdl_tpu train --help` for the flag surface",
+            file=sys.stderr,
+        )
+        return 0 if argv else 1
+    verb, rest = argv[0], argv[1:]
+    if verb not in VERBS:
+        print(
+            f"unknown verb {verb!r}; expected one of {sorted(VERBS)}",
+            file=sys.stderr,
+        )
+        return 1
+    args = client_parser(verb).parse_args(rest)
+    try:
+        return VERBS[verb](args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,93 @@ def build_worker_pod_manifest(
     }
 
 
+def build_master_pod_manifest(
+    job_name: str,
+    image: str,
+    command: List[str],
+    namespace: str = "default",
+    resource_request: str = "",
+    resource_limit: str = "",
+    pod_priority: str = "",
+    volume: str = "",
+    envs: Optional[Dict[str, str]] = None,
+    restart_policy: str = "Never",
+) -> dict:
+    """The master pod the client submits (reference: k8s_client.py:214-246
+    `create_master`, api.py:205-223). Same label schema as workers so
+    one selector watches the whole job; MY_POD_IP via the downward API
+    so the master can advertise a worker-reachable address."""
+    requests = k8s_resource.parse(resource_request)
+    limits = k8s_resource.parse(resource_limit) if resource_limit else requests
+    env = [{"name": k, "value": v} for k, v in sorted((envs or {}).items())]
+    env.append(
+        {
+            "name": "MY_POD_IP",
+            "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
+        }
+    )
+    container: dict = {
+        "name": "master",
+        "image": image,
+        "command": command,
+        "resources": {"requests": requests, "limits": limits},
+        "env": env,
+    }
+    spec: dict = {
+        "containers": [container],
+        "restartPolicy": restart_policy,
+    }
+    if pod_priority:
+        spec["priorityClassName"] = pod_priority
+    if volume:
+        vol = k8s_volume.parse(volume)
+        spec["volumes"] = [
+            {
+                "name": "elasticdl-volume",
+                "persistentVolumeClaim": {"claimName": vol["claim_name"]},
+            }
+        ]
+        container["volumeMounts"] = [
+            {"name": "elasticdl-volume", "mountPath": vol["mount_path"]}
+        ]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": master_pod_name(job_name),
+            "namespace": namespace,
+            "labels": {
+                "app": "elasticdl",
+                ELASTICDL_JOB_KEY: job_name,
+                ELASTICDL_REPLICA_TYPE_KEY: "master",
+                ELASTICDL_REPLICA_INDEX_KEY: "0",
+            },
+        },
+        "spec": spec,
+    }
+
+
+def create_master_pod(
+    manifest: dict, namespace: str = "default", cluster_spec_file: str = ""
+):
+    """Submit a master pod manifest to the apiserver (the client's side
+    of the job lifecycle — reference: k8s_client.py:214-246). Needs the
+    `kubernetes` package and an RBAC grant like
+    manifests/examples/elasticdl-rbac.yaml."""
+    try:
+        from kubernetes import client, config  # noqa: F401
+    except ImportError as e:  # pragma: no cover - gated by env
+        raise RuntimeError(
+            "submitting to a cluster requires the `kubernetes` package"
+        ) from e
+    try:
+        config.load_incluster_config()
+    except Exception:
+        config.load_kube_config()
+    manifest = apply_cluster_spec(manifest, cluster_spec_file)
+    return client.CoreV1Api().create_namespaced_pod(namespace, manifest)
+
+
 def build_tensorboard_service_manifest(
     job_name: str, namespace: str = "default", port: int = 6006
 ) -> dict:
@@ -134,6 +221,46 @@ def build_tensorboard_service_manifest(
             "ports": [{"port": port, "targetPort": port}],
         },
     }
+
+
+def create_tensorboard_service(
+    job_name: str, namespace: str = "default", port: int = 6006
+):
+    """Create the TB LoadBalancer Service from the manifest builder
+    (reference: k8s_tensorboard_client.py:66-86)."""
+    try:
+        from kubernetes import client, config  # noqa: F401
+    except ImportError as e:  # pragma: no cover - gated by env
+        raise RuntimeError(
+            "creating a service requires the `kubernetes` package"
+        ) from e
+    try:
+        config.load_incluster_config()
+    except Exception:
+        config.load_kube_config()
+    manifest = build_tensorboard_service_manifest(job_name, namespace, port)
+    return client.CoreV1Api().create_namespaced_service(namespace, manifest)
+
+
+def get_tensorboard_external_ip(
+    job_name: str, namespace: str = "default", timeout: float = 300.0
+) -> Optional[str]:
+    """Poll the TB Service for its LoadBalancer ingress IP
+    (reference: k8s_tensorboard_client.py:88-100)."""
+    import time as _time
+
+    from kubernetes import client
+
+    core = client.CoreV1Api()
+    deadline = _time.time() + timeout
+    name = tensorboard_service_name(job_name)
+    while _time.time() < deadline:
+        svc = core.read_namespaced_service(name, namespace)
+        ingress = (svc.status.load_balancer.ingress or []) if svc.status else []
+        if ingress and ingress[0].ip:
+            return ingress[0].ip
+        _time.sleep(5)
+    return None
 
 
 def apply_cluster_spec(pod: dict, cluster_spec_file: str) -> dict:

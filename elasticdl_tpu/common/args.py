@@ -189,6 +189,91 @@ def validate_master_args(args) -> str:
     raise ValueError("one of training/evaluation/prediction data dirs required")
 
 
+def add_client_args(parser: argparse.ArgumentParser):
+    """Client-only flags: image build & master-pod shape (reference:
+    common/args.py image/registry params :45-174, api.py:11-227)."""
+    parser.add_argument(
+        "--image_base", default="python:3.10-slim",
+        help="base image for the synthesized job Dockerfile",
+    )
+    parser.add_argument(
+        "--docker_image_repository", default="",
+        help="registry prefix to tag (and optionally push) the job image",
+    )
+    parser.add_argument(
+        "--push_image", action="store_true",
+        help="push the built image to --docker_image_repository",
+    )
+    parser.add_argument(
+        "--image_name", default="",
+        help="use this prebuilt image instead of building one",
+    )
+    parser.add_argument(
+        "--master_resource_request", default="cpu=1,memory=2048Mi",
+        help="k8s resource DSL for the master pod",
+    )
+    parser.add_argument("--master_resource_limit", default="")
+    parser.add_argument("--master_pod_priority", default="")
+    parser.add_argument(
+        "--dry_run", action="store_true",
+        help="print the master pod manifest instead of creating it",
+    )
+
+
+def client_parser(verb: str) -> argparse.ArgumentParser:
+    """One sub-verb parser: the client accepts the full master flag
+    surface (it forwards them as the master pod's container args —
+    the flag namespace is the submit protocol, reference api.py:23-91)
+    plus the client-only image/submit flags."""
+    p = argparse.ArgumentParser(
+        prog=f"elasticdl_tpu {verb}",
+        description=f"ElasticDL-TPU client: {verb} job",
+    )
+    add_model_spec_args(p)
+    add_master_args(p)
+    add_client_args(p)
+    return p
+
+
+_CLIENT_ONLY_DESTS = frozenset(
+    (
+        "image_base",
+        "docker_image_repository",
+        "push_image",
+        "image_name",
+        "master_resource_request",
+        "master_resource_limit",
+        "master_pod_priority",
+        "dry_run",
+    )
+)
+
+
+def master_forward_args(args) -> List[str]:
+    """Serialize a parsed arg-set back into master argv — the client
+    assembles the master pod's container args from exactly the flags it
+    parsed (reference api.py:23-91). Client-only flags are dropped;
+    defaults are skipped so the manifest stays readable; the round trip
+    `master_parser().parse_args(master_forward_args(a))` reproduces `a`
+    (asserted by tests/test_client.py)."""
+    argv: List[str] = []
+    for action in master_parser()._actions:
+        dest = action.dest
+        if dest in ("help",) or dest in _CLIENT_ONLY_DESTS:
+            continue
+        if not hasattr(args, dest):
+            continue
+        value = getattr(args, dest)
+        if isinstance(action, argparse._StoreTrueAction):
+            if value:
+                argv.append(action.option_strings[0])
+            continue
+        if not action.required and value == action.default:
+            continue
+        argv += [action.option_strings[0], str(value)]
+    return argv
+
+
 def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:
     """The model-spec flag subset a master forwards to each worker
     (reference: master/main.py:229-255)."""

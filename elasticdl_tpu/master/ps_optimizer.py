@@ -43,6 +43,18 @@ class PSOptimizer:
     def initialized(self) -> bool:
         return self._state is not None
 
+    def warmup(self, params: Any):
+        """Compile the jitted apply for `params`' shapes ahead of the
+        hot path (bench AOT): one zero-gradient update whose result is
+        discarded, leaving optimizer state untouched."""
+        if self._state is None:
+            self.initialize(params)
+        zeros = jax.tree_util.tree_map(
+            lambda p: np.zeros_like(p, dtype=np.float32), params
+        )
+        with jax.default_device(_cpu_device()):
+            jax.block_until_ready(self._apply(params, zeros, self._state))
+
     def step(self, params: Any, grads: Any) -> Any:
         """Apply averaged gradients; returns the new params pytree (numpy)."""
         if self._state is None:

@@ -1,0 +1,218 @@
+"""Client plane (L6) tests: flag round-trip, master-pod manifest
+assembly, Dockerfile synthesis (no docker daemon — mirroring the
+reference's image_builder_test.py), and a process-mode e2e job driven
+from the CLI (reference: client.py:12-39, api.py:11-227)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from elasticdl_tpu.client import api, image_builder  # noqa: E402
+from elasticdl_tpu.client.main import main as client_main  # noqa: E402
+from elasticdl_tpu.common.args import (  # noqa: E402
+    client_parser,
+    master_forward_args,
+    master_parser,
+)
+from elasticdl_tpu.testing import write_linear_records  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _client_args(extra=()):
+    return client_parser("train").parse_args(
+        [
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--training_data_dir", "/data/train",
+            "--num_workers", "3",
+            "--num_epochs", "2",
+            "--grads_to_wait", "1",
+            "--job_name", "demo",
+            "--worker_backend", "k8s",
+            "--image_name", "reg.example/edl:tag",
+            "--envs", "FOO=bar",
+            *extra,
+        ]
+    )
+
+
+# -- flag round-trip: CLI -> master argv -> parsed master args ------------
+
+
+def test_master_forward_args_round_trip():
+    args = _client_args()
+    argv = master_forward_args(args)
+    reparsed = master_parser().parse_args(argv)
+    for action in master_parser()._actions:
+        if action.dest == "help":
+            continue
+        assert getattr(reparsed, action.dest) == getattr(args, action.dest), (
+            action.dest
+        )
+
+
+def test_master_forward_args_drops_client_only_flags():
+    args = _client_args(extra=("--master_pod_priority", "high", "--dry_run"))
+    argv = master_forward_args(args)
+    assert "--master_pod_priority" not in argv
+    assert "--dry_run" not in argv
+
+
+def test_store_true_flags_forwarded():
+    args = _client_args(extra=("--use_async",))
+    argv = master_forward_args(args)
+    assert "--use_async" in argv
+    assert master_parser().parse_args(argv).use_async
+
+
+# -- master pod manifest --------------------------------------------------
+
+
+def test_build_master_manifest():
+    args = _client_args(
+        extra=("--master_resource_request", "cpu=2,memory=4096Mi")
+    )
+    manifest = api.build_master_manifest(args, "reg.example/edl:tag")
+    assert manifest["metadata"]["name"] == "elasticdl-demo-master"
+    labels = manifest["metadata"]["labels"]
+    assert labels["elasticdl-job-name"] == "demo"
+    assert labels["elasticdl-replica-type"] == "master"
+    container = manifest["spec"]["containers"][0]
+    assert container["image"] == "reg.example/edl:tag"
+    assert container["resources"]["requests"] == {
+        "cpu": "2",
+        "memory": "4096Mi",
+    }
+    # downward-API pod IP so the master advertises a reachable addr
+    assert any(e.get("name") == "MY_POD_IP" for e in container["env"])
+    assert any(e.get("name") == "FOO" for e in container["env"])
+    cmd = container["command"]
+    assert cmd[:3] == ["python", "-m", "elasticdl_tpu.master.main"]
+    # model zoo remapped into the image; worker image defaulted
+    assert cmd[cmd.index("--model_zoo") + 1] == image_builder.IMAGE_MODEL_ZOO
+    assert cmd[cmd.index("--worker_image") + 1] == "reg.example/edl:tag"
+    # the pod's container args parse as valid master args (the manifest
+    # IS the config protocol)
+    master_parser().parse_args(cmd[3:])
+
+
+def test_cli_dry_run_prints_manifest(capsys):
+    rc = client_main(
+        [
+            "train",
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--training_data_dir", "/data",
+            "--worker_backend", "k8s",
+            "--image_name", "img:1",
+            "--dry_run",
+        ]
+    )
+    assert rc == 0
+    manifest = json.loads(capsys.readouterr().out)
+    assert manifest["kind"] == "Pod"
+
+
+def test_cli_rejects_bad_verb_and_bad_args(capsys):
+    assert client_main(["frobnicate"]) == 1
+    # evaluation without an init checkpoint is a client-side error
+    rc = client_main(
+        [
+            "evaluate",
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--evaluation_data_dir", "/data",
+            "--worker_backend", "k8s",
+            "--image_name", "img:1",
+            "--dry_run",
+        ]
+    )
+    assert rc == 1
+    assert "checkpoint_filename_for_init" in capsys.readouterr().err
+
+
+def test_k8s_submit_requires_image():
+    args = _client_args()
+    args.image_name = ""
+    with pytest.raises(ValueError, match="image"):
+        api._submit_job(args)
+
+
+# -- image builder (no daemon) -------------------------------------------
+
+
+def test_stage_and_dockerfile(tmp_path):
+    zoo = tmp_path / "zoo"
+    zoo.mkdir()
+    (zoo / "model.py").write_text("x = 1\n")
+    spec_file = tmp_path / "cs.py"
+    spec_file.write_text("def with_pod(p):\n    return p\n")
+    ctx = image_builder.stage_build_context(
+        str(zoo), cluster_spec=str(spec_file), dest=str(tmp_path / "ctx")
+    )
+    assert os.path.isfile(
+        os.path.join(ctx, "elasticdl_tpu_src", "elasticdl_tpu", "__init__.py")
+    )
+    assert os.path.isfile(
+        os.path.join(ctx, "elasticdl_tpu_src", "setup.py")
+    )
+    assert os.path.isfile(os.path.join(ctx, "model_zoo", "model.py"))
+    assert os.path.isfile(os.path.join(ctx, "cluster_spec", "cs.py"))
+    dockerfile = image_builder.write_dockerfile(ctx, "jax-base:latest")
+    text = open(dockerfile).read()
+    assert text.startswith("FROM jax-base:latest\n")
+    assert "import jax" in text  # runtime presence check
+    assert f"COPY model_zoo {image_builder.IMAGE_MODEL_ZOO}" in text
+    assert f"COPY cluster_spec {image_builder.IMAGE_CLUSTER_SPEC_DIR}" in text
+    assert "pip install" in text
+
+
+def test_build_without_docker_raises(tmp_path):
+    zoo = tmp_path / "zoo"
+    zoo.mkdir()
+    with pytest.raises(RuntimeError, match="not found"):
+        image_builder.build_and_push_docker_image(
+            str(zoo), "base:1", docker_bin="definitely-not-docker-bin"
+        )
+
+
+# -- process-mode e2e driven from the CLI --------------------------------
+
+
+def test_cli_process_mode_e2e(tmp_path):
+    """`elasticdl_tpu train --worker_backend=process` runs a REAL local
+    job: master subprocess + worker subprocesses, converged --output."""
+    tmp = str(tmp_path)
+    path = os.path.join(tmp, "train.rio")
+    write_linear_records(path, 128, noise=0.05)
+    output = os.path.join(tmp, "final.ckpt")
+    rc = client_main(
+        [
+            "train",
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--training_data_dir", tmp,
+            "--records_per_task", "32",
+            "--num_epochs", "2",
+            "--grads_to_wait", "1",
+            "--num_workers", "2",
+            "--worker_backend", "process",
+            "--output", output,
+        ]
+    )
+    assert rc == 0
+    from elasticdl_tpu.master.checkpoint import load_model_file
+
+    model = load_model_file(output)
+    kernel = np.asarray(model.params["Dense_0"]["kernel"]).ravel()
+    assert abs(kernel[0] - 2.0) < 0.3, kernel

@@ -71,8 +71,10 @@ def test_async_lr_staleness_modulation():
 
 def test_async_two_workers_converge(tmp_path):
     path = str(tmp_path / "train.rio")
+    # 4 epochs: async racing workers converge stochastically; a 2-epoch
+    # run intermittently lands just outside the 0.3 tolerance
     write_linear_records(path, 128, noise=0.05)
-    dispatcher = TaskDispatcher({path: 128}, {}, {}, 16, 2)
+    dispatcher = TaskDispatcher({path: 128}, {}, {}, 16, 4)
     spec = spec_from_module(linear_module)
     servicer, _, _ = build_job(spec, dispatcher, use_async=True)
     shim = InProcessMaster(servicer)
