@@ -1,0 +1,22 @@
+"""Filesystem helpers (reference: common/file_helper.py)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+
+def copy_if_not_exists(src: str, dst: str, is_dir: bool):
+    """Copy src -> dst unless dst already exists (used when staging
+    model-zoo files into job images/volumes)."""
+    if os.path.exists(dst):
+        logger.info("Skip copying %s -> %s: destination exists", src, dst)
+        return
+    if is_dir:
+        shutil.copytree(src, dst)
+    else:
+        shutil.copy(src, dst)

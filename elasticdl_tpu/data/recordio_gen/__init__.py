@@ -1,0 +1,2 @@
+"""Dataset -> RecordIO converters (reference:
+elasticdl/python/data/recordio_gen/)."""

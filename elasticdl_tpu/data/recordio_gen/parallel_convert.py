@@ -1,0 +1,97 @@
+"""Parallel raw-file -> RecordIO conversion driver.
+
+Re-design of the reference's PySpark sample
+(elasticdl/python/data/recordio_gen/sample_pyspark_recordio_gen/
+spark_gen_recordio.py:14-96): the reference partitions a tar of raw
+files across Spark executors, each calling a user
+`prepare_data_for_a_single_file(file_object, filename) -> bytes`
+loaded from a module. Spark is not part of this stack; a
+`multiprocessing` pool gives the same data-parallel conversion on one
+host, and the user-function contract is preserved so the same prep
+modules work.
+
+CLI:
+  python -m elasticdl_tpu.data.recordio_gen.parallel_convert OUT_DIR \
+      --input 'raw/*.jpg' --prep_module prep.py --num_workers 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+from multiprocessing import Pool
+from typing import Iterable, List, Optional
+
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.data.recordio import RecordIOWriter
+
+logger = get_logger(__name__)
+
+
+def _convert_partition(job) -> str:
+    """One worker: run the user prep fn over its files, write one shard."""
+    (files, prep_path, out_path) = job
+    from elasticdl_tpu.api.model_spec import load_module
+
+    prep = load_module(prep_path).prepare_data_for_a_single_file
+    with RecordIOWriter(out_path) as w:
+        for path in files:
+            with open(path, "rb") as f:
+                w.write(prep(f, path))
+    logger.info("Wrote %d records -> %s", len(files), out_path)
+    return out_path
+
+
+def convert_files(
+    files: List[str],
+    prep_module: str,
+    out_dir: str,
+    records_per_shard: int = 16 * 1024,
+    num_workers: int = os.cpu_count() or 1,
+) -> List[str]:
+    """Partition `files` into shards of `records_per_shard` and convert
+    them on a process pool. Returns the shard paths."""
+    os.makedirs(out_dir, exist_ok=True)
+    jobs = []
+    for shard, start in enumerate(range(0, len(files), records_per_shard)):
+        jobs.append(
+            (
+                files[start : start + records_per_shard],
+                prep_module,
+                os.path.join(out_dir, "data-%05d" % shard),
+            )
+        )
+    if num_workers <= 1 or len(jobs) == 1:
+        return [_convert_partition(j) for j in jobs]
+    with Pool(min(num_workers, len(jobs))) as pool:
+        return list(pool.map(_convert_partition, jobs))
+
+
+def main(argv: Optional[Iterable[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Convert raw files into RecordIO shards in parallel"
+    )
+    parser.add_argument("dir", help="output directory")
+    parser.add_argument("--input", required=True, help="glob of raw files")
+    parser.add_argument(
+        "--prep_module", required=True,
+        help="python file defining prepare_data_for_a_single_file(f, name)",
+    )
+    parser.add_argument("--records_per_shard", type=int, default=16 * 1024)
+    parser.add_argument("--num_workers", type=int, default=os.cpu_count() or 1)
+    args = parser.parse_args(argv)
+    files = sorted(glob.glob(args.input))
+    if not files:
+        logger.error("no files match %r", args.input)
+        return 1
+    convert_files(
+        files, args.prep_module, args.dir, args.records_per_shard,
+        args.num_workers,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

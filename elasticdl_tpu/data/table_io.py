@@ -1,0 +1,209 @@
+"""Pluggable table IO — the ODPS reader/writer capability, generalized.
+
+Re-design of the reference's ODPS integration
+(elasticdl/python/common/odps_io.py:112-393): `ODPSReader.to_iterator`
+yields worker-sliced record batches from a cloud table and
+`ODPSWriter.from_iterator` writes prediction outputs back. That
+capability is a *protocol*, not an ODPS detail, so here it is an
+interface with pluggable backends:
+
+- `SqliteTableReader/Writer` — stdlib sqlite3; always available, real
+  SQL tables for local runs and tests;
+- `OdpsTableReader/Writer` — the reference's backend, import-gated on
+  the `odps` package (absent in this image: constructing it raises a
+  clear error, the rest of the framework never imports it).
+
+Reader semantics mirror the reference `to_iterator(num_workers,
+worker_index, batch_size, epochs, shuffle, columns, limit)`: the row
+space is split into batch-sized slices, slice i belongs to worker
+`i % num_workers`, repeated for `epochs`, optionally shuffled per epoch.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+
+class TableReader:
+    """Interface: worker-sliced batched iteration over a table."""
+
+    def count(self) -> int:
+        raise NotImplementedError
+
+    def columns(self) -> List[str]:
+        raise NotImplementedError
+
+    def read_slice(
+        self, start: int, end: int, columns: Optional[Sequence[str]] = None
+    ) -> List[Tuple]:
+        raise NotImplementedError
+
+    def to_iterator(
+        self,
+        num_workers: int,
+        worker_index: int,
+        batch_size: int,
+        epochs: int = 1,
+        shuffle: bool = False,
+        columns: Optional[Sequence[str]] = None,
+        limit: int = -1,
+        seed: int = 0,
+    ) -> Iterator[List[Tuple]]:
+        """reference: odps_io.py:153-277."""
+        if not worker_index < num_workers:
+            raise ValueError("worker_index must be < num_workers")
+        if batch_size <= 0:
+            raise ValueError("batch_size should be positive")
+        size = self.count()
+        if 0 < limit < size:
+            size = limit
+        starts = [
+            s
+            for i, s in enumerate(range(0, size, batch_size))
+            if i % num_workers == worker_index
+        ]
+        rng = random.Random(seed)
+        for epoch in range(epochs):
+            order = list(starts)
+            if shuffle:
+                rng.shuffle(order)
+            for start in order:
+                rows = self.read_slice(
+                    start, min(start + batch_size, size), columns
+                )
+                if rows:
+                    yield rows
+
+
+class TableWriter:
+    """Interface: append record batches (reference: from_iterator)."""
+
+    def write(self, rows: Sequence[Tuple]):
+        raise NotImplementedError
+
+    def from_iterator(self, records_iter, worker_index: int = 0):
+        n = 0
+        for batch in records_iter:
+            self.write(batch)
+            n += len(batch)
+        logger.info("worker %d wrote %d rows", worker_index, n)
+
+    def close(self):
+        pass
+
+
+# ----------------------------------------------------------------- sqlite
+
+
+class SqliteTableReader(TableReader):
+    def __init__(self, path: str, table: str):
+        import sqlite3
+
+        self._conn = sqlite3.connect(path)
+        self._table = table
+
+    def count(self) -> int:
+        (n,) = self._conn.execute(
+            f"SELECT COUNT(*) FROM {self._table}"
+        ).fetchone()
+        return n
+
+    def columns(self) -> List[str]:
+        cur = self._conn.execute(f"SELECT * FROM {self._table} LIMIT 0")
+        return [d[0] for d in cur.description]
+
+    def read_slice(self, start, end, columns=None):
+        cols = ", ".join(columns) if columns else "*"
+        return self._conn.execute(
+            f"SELECT {cols} FROM {self._table} "
+            f"LIMIT {end - start} OFFSET {start}"
+        ).fetchall()
+
+    def close(self):
+        self._conn.close()
+
+
+class SqliteTableWriter(TableWriter):
+    def __init__(self, path: str, table: str, columns: Sequence[str]):
+        import sqlite3
+
+        self._conn = sqlite3.connect(path)
+        self._table = table
+        self._cols = list(columns)
+        spec = ", ".join(self._cols)
+        self._conn.execute(f"CREATE TABLE IF NOT EXISTS {table} ({spec})")
+
+    def write(self, rows):
+        ph = ", ".join("?" for _ in self._cols)
+        self._conn.executemany(
+            f"INSERT INTO {self._table} VALUES ({ph})", rows
+        )
+        self._conn.commit()
+
+    def close(self):
+        self._conn.close()
+
+
+# ------------------------------------------------------------------- odps
+
+
+class OdpsTableReader(TableReader):
+    """reference: odps_io.py:112-151 constructor surface."""
+
+    def __init__(
+        self,
+        project: str,
+        access_id: str,
+        access_key: str,
+        endpoint: str,
+        table: str,
+        partition: Optional[str] = None,
+    ):
+        try:
+            from odps import ODPS  # noqa: F401
+        except ImportError as e:  # pragma: no cover - package not in image
+            raise RuntimeError(
+                "OdpsTableReader requires the `odps` (pyodps) package"
+            ) from e
+        if "." in table:
+            project, table = table.split(".", 1)
+        self._odps = ODPS(access_id, access_key, project, endpoint)
+        self._table = self._odps.get_table(table)
+        self._partition = partition
+
+    def count(self) -> int:  # pragma: no cover - needs a live cluster
+        with self._table.open_reader(partition=self._partition) as r:
+            return r.count
+
+    def columns(self) -> List[str]:  # pragma: no cover
+        return [c.name for c in self._table.schema.columns]
+
+    def read_slice(self, start, end, columns=None):  # pragma: no cover
+        with self._table.open_reader(partition=self._partition) as r:
+            return [
+                tuple(rec[c] for c in (columns or self.columns()))
+                for rec in r[start:end]
+            ]
+
+
+class OdpsTableWriter(TableWriter):  # pragma: no cover - needs a cluster
+    """reference: odps_io.py:322-393."""
+
+    def __init__(self, project, access_id, access_key, endpoint, table):
+        try:
+            from odps import ODPS  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "OdpsTableWriter requires the `odps` (pyodps) package"
+            ) from e
+        self._odps = ODPS(access_id, access_key, project, endpoint)
+        self._table = self._odps.get_table(table)
+
+    def write(self, rows):
+        with self._table.open_writer() as w:
+            w.write(list(rows))

@@ -129,3 +129,22 @@ def write_synthetic_token_records(
         for _ in range(n):
             toks = rng.integers(0, vocab, size=seq_len + 1)
             w.write(encode_token_record(toks))
+
+
+def write_learnable_token_records(
+    path: str, n: int, seq_len: int, vocab: int, seed: int = 0
+):
+    """Arithmetic token sequences mod vocab (stride in {1,2,3}): the
+    next token is a deterministic function of the previous one and the
+    in-context stride, so a small attention LM's loss must fall well
+    below ln(vocab) — the convergence subject for transformer job
+    tests."""
+    rng = np.random.default_rng(seed)
+    from elasticdl_tpu.data.recordio import RecordIOWriter
+
+    with RecordIOWriter(path) as w:
+        for _ in range(n):
+            start = int(rng.integers(vocab))
+            stride = int(rng.integers(1, 4))
+            toks = (start + stride * np.arange(seq_len + 1)) % vocab
+            w.write(encode_token_record(toks))

@@ -1,0 +1,136 @@
+"""Dataset->RecordIO converters (reference:
+data/recordio_gen/image_label.py:12-104, frappe_recordio_gen.py,
+spark_gen_recordio.py:14-96). VERDICT r2 missing #3: real-dataset
+converters so model-zoo jobs can train on standard dataset files."""
+
+import gzip
+import os
+import pickle
+
+import numpy as np
+
+from elasticdl_tpu.data.recordio import RecordIOReader, count_records
+from elasticdl_tpu.data.recordio_gen import image_label, parallel_convert, tabular
+from elasticdl_tpu.models.record_codec import (
+    decode_image_records,
+    decode_tabular_records,
+)
+
+
+def _write_idx(path, arr, gz=False):
+    dims = arr.shape
+    header = (0x0800 | len(dims)).to_bytes(4, "big") + b"".join(
+        d.to_bytes(4, "big") for d in dims
+    )
+    opener = gzip.open if gz else open
+    with opener(path, "wb") as f:
+        f.write(header + arr.tobytes())
+
+
+def test_mnist_idx_convert_and_train_decode(tmp_path):
+    """Fake MNIST IDX files -> shards -> decodable by the model zoo's
+    dataset_fn codec."""
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(0)
+    x_train = rng.integers(0, 255, (70, 28, 28), dtype=np.uint8)
+    y_train = rng.integers(0, 10, 70).astype(np.uint8)
+    x_test = rng.integers(0, 255, (20, 28, 28), dtype=np.uint8)
+    y_test = rng.integers(0, 10, 20).astype(np.uint8)
+    _write_idx(str(src / "train-images-idx3-ubyte.gz"), x_train, gz=True)
+    _write_idx(str(src / "train-labels-idx1-ubyte.gz"), y_train, gz=True)
+    _write_idx(str(src / "t10k-images-idx3-ubyte"), x_test)
+    _write_idx(str(src / "t10k-labels-idx1-ubyte"), y_test)
+
+    out = str(tmp_path / "out")
+    rc = image_label.main(
+        [out, "--dataset", "mnist", "--source", str(src),
+         "--records_per_shard", "32"]
+    )
+    assert rc == 0
+    train_dir = os.path.join(out, "mnist", "train")
+    shards = sorted(os.listdir(train_dir))
+    assert len(shards) == 3  # 70 records / 32 per shard
+    total = sum(count_records(os.path.join(train_dir, s)) for s in shards)
+    assert total == 70
+    with RecordIOReader(os.path.join(train_dir, shards[0])) as r:
+        records = list(r.read_range(0, 4))
+    imgs, labels = decode_image_records(records, (28, 28, 1), scale=False)
+    np.testing.assert_array_equal(imgs[..., 0], x_train[:4])
+    np.testing.assert_array_equal(labels, y_train[:4])
+
+
+def test_cifar10_pickle_convert(tmp_path):
+    src = tmp_path / "cifar-10-batches-py"
+    src.mkdir()
+    rng = np.random.default_rng(1)
+    for i in range(1, 6):
+        data = rng.integers(0, 255, (10, 3 * 32 * 32), dtype=np.uint8)
+        with open(src / f"data_batch_{i}", "wb") as f:
+            pickle.dump(
+                {b"data": data, b"labels": list(rng.integers(0, 10, 10))}, f
+            )
+    with open(src / "test_batch", "wb") as f:
+        pickle.dump(
+            {b"data": rng.integers(0, 255, (10, 3072), dtype=np.uint8),
+             b"labels": list(rng.integers(0, 10, 10))}, f
+        )
+    out = str(tmp_path / "out")
+    rc = image_label.main(
+        [out, "--dataset", "cifar10", "--source", str(tmp_path)]
+    )
+    assert rc == 0
+    train = os.path.join(out, "cifar10", "train", "data-00000")
+    assert count_records(train) == 50
+    with RecordIOReader(train) as r:
+        imgs, _ = decode_image_records(
+            list(r.read_range(0, 2)), (32, 32, 3), scale=False
+        )
+    assert imgs.shape == (2, 32, 32, 3)
+
+
+def test_tabular_libfm_convert(tmp_path):
+    libfm = tmp_path / "train.libfm"
+    libfm.write_text(
+        "1 10:1 20:1 30:1\n"
+        "0 10:1 40:1\n"
+        "-1 50:1 20:1 60:1 70:1\n"
+    )
+    out = str(tmp_path / "out")
+    rc = tabular.main([out, "--train", str(libfm), "--records_per_shard", "8"])
+    assert rc == 0
+    shard = os.path.join(out, "train", "data-00000")
+    with RecordIOReader(shard) as r:
+        records = list(r.read_range(0, 3))
+    ids, labels = decode_tabular_records(records, 4)  # maxlen 4
+    assert labels.tolist() == [1.0, 0.0, 0.0]  # -1 -> 0
+    assert ids[0].tolist() == [1, 2, 3, 0]  # dense remap, 0-padded
+    assert ids[1, 0] == 1  # shared feature 10 -> same dense id
+    import json
+
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta == {"feature_num": 7, "maxlen": 4}
+
+
+def test_parallel_convert(tmp_path):
+    raw = tmp_path / "raw"
+    raw.mkdir()
+    for i in range(10):
+        (raw / f"f{i:02d}.txt").write_bytes(b"payload-%d" % i)
+    prep = tmp_path / "prep.py"
+    prep.write_text(
+        "def prepare_data_for_a_single_file(f, name):\n"
+        "    return f.read()\n"
+    )
+    out = str(tmp_path / "out")
+    paths = parallel_convert.convert_files(
+        sorted(str(p) for p in raw.iterdir()),
+        str(prep),
+        out,
+        records_per_shard=4,
+        num_workers=2,
+    )
+    assert len(paths) == 3
+    assert sum(count_records(p) for p in paths) == 10
+    with RecordIOReader(paths[0]) as r:
+        assert list(r.read_range(0, 1))[0] == b"payload-0"
