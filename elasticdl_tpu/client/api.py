@@ -125,6 +125,25 @@ def _submit_job(args) -> int:
             master_pod_name(args.job_name),
             args.namespace,
         )
+        if getattr(args, "tensorboard_log_dir", ""):
+            # LoadBalancer Service in front of the master's TensorBoard
+            # (reference: client creates it and polls the ingress IP,
+            # common/k8s_tensorboard_client.py:66-100)
+            from elasticdl_tpu.cluster.k8s_backend import (
+                create_tensorboard_service,
+                get_tensorboard_external_ip,
+            )
+
+            create_tensorboard_service(args.job_name, args.namespace)
+            ip = get_tensorboard_external_ip(
+                args.job_name, args.namespace, timeout=120
+            )
+            if ip:
+                logger.info("TensorBoard: http://%s:6006", ip)
+            else:
+                logger.warning(
+                    "TensorBoard service created; no ingress IP yet"
+                )
         return 0
     # process backend: run the master here and wait for the job
     argv = master_forward_args(args)

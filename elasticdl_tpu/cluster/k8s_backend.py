@@ -276,6 +276,28 @@ def apply_cluster_spec(pod: dict, cluster_spec_file: str) -> dict:
     return module.with_pod(pod)
 
 
+def _container_exit_code(pod) -> Optional[int]:
+    """Exit code of the WORKER container on a terminal pod, if the
+    status has landed yet (kubelet may report phase before statuses).
+    Matched by container name so an injected sidecar (istio etc.)
+    cannot mask the worker's code; falls back to the first terminated
+    container for pods without one named 'worker'."""
+    try:
+        statuses = pod.status.container_statuses or []
+        fallback = None
+        for cs in statuses:
+            term = cs.state.terminated if cs.state else None
+            if term is not None:
+                if cs.name == "worker":
+                    return term.exit_code
+                if fallback is None:
+                    fallback = term.exit_code
+        return fallback
+    except Exception:
+        pass
+    return None
+
+
 class K8sBackend(PodBackend):
     """Pods via the kubernetes API; the watch stream feeds PodEvents.
 
@@ -366,6 +388,7 @@ class K8sBackend(PodBackend):
         """Label-selector pod watch on a daemon thread
         (reference: k8s_client.py:58-77)."""
         selector = f"{ELASTICDL_JOB_KEY}={self._job_name}"
+        backoff = 1.0
         while not self._stop.is_set():
             try:
                 w = self._watch_mod.Watch()
@@ -386,13 +409,27 @@ class K8sBackend(PodBackend):
                         phase = PodPhase.DELETED
                     else:
                         phase = pod.status.phase
+                    # surface the container exit code on terminal pods:
+                    # WorkerManager distinguishes "completed with
+                    # dropped tasks" (EXIT_CODE_JOB_FAILED — do NOT
+                    # relaunch) from a crash purely by exit code
+                    exit_code = None
+                    if phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
+                        exit_code = _container_exit_code(pod)
                     if self._cb:
-                        self._cb(PodEvent(wid, phase))
+                        self._cb(PodEvent(wid, phase, exit_code=exit_code))
+                backoff = 1.0  # clean stream end: reconnect quickly
             except Exception:
                 if not self._stop.is_set():
                     logger.warning(
-                        "pod watch error, retrying:\n%s", traceback.format_exc()
+                        "pod watch error, retrying in %.0fs:\n%s",
+                        backoff,
+                        traceback.format_exc(),
                     )
+                    # exponential backoff so an unreachable apiserver
+                    # does not hot-spin the watch thread
+                    self._stop.wait(backoff)
+                    backoff = min(backoff * 2, 30.0)
 
     def stop(self):
         self._stop.set()

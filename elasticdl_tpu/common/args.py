@@ -73,6 +73,11 @@ def add_model_spec_args(parser: argparse.ArgumentParser):
         help="wire dtype for gradients/deltas",
     )
     parser.add_argument("--log_level", default="INFO")
+    parser.add_argument(
+        "--profile_dir", default="",
+        help="write a jax.profiler device trace per worker here "
+        "(TensorBoard/Perfetto-viewable)",
+    )
 
 
 def add_master_args(parser: argparse.ArgumentParser):
@@ -106,6 +111,11 @@ def add_master_args(parser: argparse.ArgumentParser):
     parser.add_argument(
         "--output", default="",
         help="save the final model here when the job finishes",
+    )
+    parser.add_argument(
+        "--tensorboard_log_dir", default="",
+        help="write train-loss + eval-metric summaries here "
+        "(torch SummaryWriter when available, JSONL fallback)",
     )
     # elasticity / cluster
     parser.add_argument("--num_workers", type=pos_int, default=1)
@@ -294,6 +304,7 @@ def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:
         "optimizer",
         "eval_metrics_fn",
         "prediction_outputs_processor",
+        "profile_dir",
     ):
         value = getattr(args, flag)
         if value:

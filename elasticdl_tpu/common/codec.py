@@ -68,7 +68,7 @@ def _dtype_to_str(dt: np.dtype) -> str:
     return dt.str
 
 
-def _dtype_from_str(s: str) -> np.dtype:
+def dtype_from_str(s: str) -> np.dtype:
     if s == "bfloat16":
         if _BFLOAT16 is None:  # pragma: no cover
             raise ValueError("bfloat16 requested but ml_dtypes unavailable")
@@ -88,7 +88,7 @@ def _encode_array(a: np.ndarray) -> dict:
 
 
 def _decode_array(m: dict) -> np.ndarray:
-    dt = _dtype_from_str(m["d"])
+    dt = dtype_from_str(m["d"])
     arr = np.frombuffer(m["b"], dtype=dt)
     return arr.reshape(m["s"])
 

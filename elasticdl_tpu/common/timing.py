@@ -7,10 +7,15 @@ SURVEY §5.1 asks for this as a first-class subsystem since the
 north-star metric is throughput retention. `PhaseTimers` is that
 subsystem: near-zero-overhead cumulative wall-clock per phase,
 snapshot-able by benches and loggable per task.
+
+Thread-safe: the worker's chained sync threads log summaries (and may
+time their own phases) while the main thread is inside `phase()` —
+the totals are lock-guarded and the nesting stack is thread-local.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
@@ -22,47 +27,57 @@ class PhaseTimers:
     `report_gradient` in the sync hot loop); each phase is charged its
     *exclusive* time — child durations are subtracted from the parent —
     so the breakdown sums to real wall clock and percentages are
-    honest."""
+    honest. Nesting is tracked per thread."""
 
     def __init__(self):
         self._seconds: Dict[str, float] = defaultdict(float)
         self._counts: Dict[str, int] = defaultdict(int)
-        self._stack: list = []  # (name, child_seconds) of open phases
+        self._local = threading.local()  # .stack: open phases, per thread
+        self._lock = threading.Lock()
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
 
     @contextmanager
     def phase(self, name: str):
         t0 = time.perf_counter()
-        self._stack.append([name, 0.0])
+        stack = self._stack()
+        stack.append([name, 0.0])
         try:
             yield
         finally:
             elapsed = time.perf_counter() - t0
-            _, child = self._stack.pop()
-            self._seconds[name] += elapsed - child
-            self._counts[name] += 1
-            if self._stack:
-                self._stack[-1][1] += elapsed
+            _, child = stack.pop()
+            with self._lock:
+                self._seconds[name] += elapsed - child
+                self._counts[name] += 1
+            if stack:
+                stack[-1][1] += elapsed
 
     def add(self, name: str, seconds: float):
-        self._seconds[name] += seconds
-        self._counts[name] += 1
+        with self._lock:
+            self._seconds[name] += seconds
+            self._counts[name] += 1
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
-        return {
-            k: {"seconds": self._seconds[k], "count": self._counts[k]}
-            for k in self._seconds
-        }
+        with self._lock:
+            return {
+                k: {"seconds": self._seconds[k], "count": self._counts[k]}
+                for k in self._seconds
+            }
 
     def summary(self) -> str:
-        total = sum(self._seconds.values()) or 1.0
-        parts = [
-            f"{k}={v:.2f}s({100 * v / total:.0f}%)"
-            for k, v in sorted(
-                self._seconds.items(), key=lambda kv: -kv[1]
-            )
-        ]
-        return " ".join(parts)
+        with self._lock:
+            items = sorted(self._seconds.items(), key=lambda kv: -kv[1])
+            total = sum(self._seconds.values()) or 1.0
+        return " ".join(
+            f"{k}={v:.2f}s({100 * v / total:.0f}%)" for k, v in items
+        )
 
     def reset(self):
-        self._seconds.clear()
-        self._counts.clear()
+        with self._lock:
+            self._seconds.clear()
+            self._counts.clear()

@@ -45,10 +45,13 @@ def collect_shards(path: str) -> dict:
     if os.path.isfile(path):
         files = [path]
     else:
+        # only regular files: a stray subdirectory (or socket) in the
+        # data dir must not crash count_records at master boot
         files = sorted(
-            os.path.join(path, f)
+            p
             for f in os.listdir(path)
             if not f.startswith(".")
+            and os.path.isfile(p := os.path.join(path, f))
         )
     shards = {f: count_records(f) for f in files}
     if not shards or not any(shards.values()):
@@ -153,6 +156,12 @@ def build_master(args, job_type: str):
         lr_staleness_modulation=args.lr_staleness_modulation,
         staleness_window=args.staleness_window,
     )
+    tb_service = None
+    if getattr(args, "tensorboard_log_dir", ""):
+        from elasticdl_tpu.master.tensorboard_service import TensorBoardService
+
+        tb_service = TensorBoardService(args.tensorboard_log_dir)
+        servicer.set_train_loss_hook(tb_service.write_train_loss)
     eval_service = None
     if with_eval:
         eval_service = EvaluationService(
@@ -166,9 +175,15 @@ def build_master(args, job_type: str):
             time_based=args.eval_throttle_secs > 0
             and job_type == JobType.TRAINING_WITH_EVALUATION,
             current_model_fn=servicer.get_params_copy,
+            metrics_writer=(
+                tb_service.write_eval_metrics if tb_service else None
+            ),
         )
         dispatcher.set_evaluation_service(eval_service)
         servicer.set_evaluation_service(eval_service)
+    # the servicer owns the sink's lifetime so callers of build_master
+    # (main, tests, benches) can tear it down uniformly
+    servicer.tb_service = tb_service
     return spec, dispatcher, servicer, eval_service, ckpt
 
 
@@ -207,9 +222,15 @@ def main(argv=None) -> int:
     from elasticdl_tpu.master.worker_manager import WorkerManager
     from elasticdl_tpu.rpc.server import RpcServer
 
-    spec, dispatcher, servicer, eval_service, ckpt = build_master(
-        args, job_type
-    )
+    try:
+        spec, dispatcher, servicer, eval_service, ckpt = build_master(
+            args, job_type
+        )
+    except (ValueError, OSError) as e:
+        # bad data dir / unreadable shards / malformed checkpoint are
+        # config errors: exit 1 cleanly, like validate_master_args
+        logger.error("master boot failed: %s", e)
+        return 1
     if job_type in (JobType.EVALUATION_ONLY, JobType.PREDICTION_ONLY):
         if not servicer.model_initialized():
             logger.error("evaluate/predict jobs need an initialized model")
@@ -234,6 +255,10 @@ def main(argv=None) -> int:
     addr = f"{host}:{server.port}"
     logger.info("Master (%s job) listening on %s", job_type, addr)
 
+    if servicer.tb_service is not None and args.worker_backend == "k8s":
+        # in-cluster: serve the summaries so the TensorBoard k8s
+        # Service (created by the client) has a target on :6006
+        servicer.tb_service.start_tensorboard_process()
     backend = make_backend(args)
     manager = WorkerManager(
         backend,
@@ -276,6 +301,8 @@ def main(argv=None) -> int:
         manager.stop_relaunch_and_remove_workers()
         if eval_service is not None:
             eval_service.stop()
+        if servicer.tb_service is not None:
+            servicer.tb_service.close()
         backend.stop()
         server.stop()
     return exit_code

@@ -153,7 +153,9 @@ class MasterServicer:
         err = req.get("err_message", "")
         if err:
             logger.warning("Worker reported error: %s", err)
-        self._task_d.report(req["task_id"], not err)
+        self._task_d.report(
+            req["task_id"], not err, worker_id=req.get("worker_id")
+        )
         return {}
 
     # -- RPC: model ---------------------------------------------------------
@@ -237,7 +239,9 @@ class MasterServicer:
                 # worker's retry needs no separate pull round-trip
                 resp = {"accepted": False, "version": self._version}
                 if req.get("return_model"):
-                    resp["params_flat"] = codec.ravel_np(self._params)
+                    resp["params_flat"] = self._flat_model(
+                        req.get("model_dtype")
+                    )
                     resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
                 return resp
             if report_version > self._version:
@@ -289,7 +293,7 @@ class MasterServicer:
                 # a step was applied (by this report or a concurrent
                 # one): hand back the new model inline — the sync-SGD
                 # inner loop becomes ONE rpc per minibatch
-                resp["params_flat"] = codec.ravel_np(self._params)
+                resp["params_flat"] = self._flat_model(req.get("model_dtype"))
                 resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
             if applied:
                 # snapshot the exact applied version UNDER the lock so a
@@ -307,6 +311,7 @@ class MasterServicer:
             # hooks run OUTSIDE the lock: the eval service calls back
             # into get_params_copy and must not deadlock
             self._on_version_bump(applied_version, ckpt_snapshot, applied_version - 1)
+            self._report_train_loss(applied_version, req.get("loss"))
         return resp
 
     def report_local_update(self, req: dict) -> dict:
@@ -369,7 +374,17 @@ class MasterServicer:
                 resp["params_flat"] = codec.ravel_np(self._params)
                 resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
         self._on_version_bump(applied_version, ckpt_snapshot, prev_version)
+        self._report_train_loss(applied_version, req.get("loss"))
         return resp
+
+    def _flat_model(self, model_dtype=None):
+        """Raveled params, optionally narrowed to the worker's wire
+        dtype (bf16 halves the piggyback bytes; the worker re-widens —
+        standard mixed-precision weight transport)."""
+        vec = codec.ravel_np(self._params)
+        if model_dtype and model_dtype != "float32":
+            vec = vec.astype(codec.dtype_from_str(model_dtype))
+        return vec
 
     def _validate(self, grads):
         """Shape sanity checks (reference: servicer.py:320-370)."""
@@ -402,6 +417,19 @@ class MasterServicer:
                 )
             self._params = self._opt.step(self._params, dense_grads)
         self._version += 1
+
+    def set_train_loss_hook(self, hook):
+        """hook(version, loss) — fed from worker-reported minibatch/
+        window losses; wired to the TensorBoard/metrics sink."""
+        self._train_loss_hook = hook
+
+    def _report_train_loss(self, version: int, loss):
+        hook = getattr(self, "_train_loss_hook", None)
+        if hook is not None and loss is not None:
+            try:
+                hook(version, float(loss))
+            except Exception:  # a metrics sink must never fail training
+                logger.exception("train-loss hook failed")
 
     def _on_version_bump(self, version: int, ckpt_snapshot=None, prev_version=None):
         """Checkpoint/eval hooks for an applied version. Caller must NOT

@@ -55,6 +55,9 @@ class TaskDispatcher:
         # task_id -> (worker_id, task), mirrors reference :48-53
         self._doing: Dict[int, Tuple[int, Task]] = {}
         self._evaluation_service = None
+        # cumulative records successfully trained (across epochs) —
+        # progress/throughput introspection for benches and logs
+        self._completed_records = 0
 
         if self._training_shards:
             logger.info("Starting epoch %d", self._epoch)
@@ -130,16 +133,35 @@ class TaskDispatcher:
             self._doing[task.task_id] = (worker_id, task)
             return task
 
-    def report(self, task_id: int, success: bool) -> bool:
+    def report(
+        self, task_id: int, success: bool, worker_id: Optional[int] = None
+    ) -> bool:
         """Worker reports task done/failed; failures are requeued
-        (reference :153-176). Returns False for unknown ids."""
+        (reference :153-176). Returns False for unknown ids.
+
+        When `worker_id` is given it must match the doing-map owner:
+        a stale duplicate report (e.g. a worker whose failed-sync path
+        already reported the task, after which another worker claimed
+        the requeued shard) must not pop the new owner's entry."""
         evaluation_task_completed = None
         with self._lock:
-            worker_and_task = self._doing.pop(task_id, None)
+            worker_and_task = self._doing.get(task_id)
             if worker_and_task is None:
                 logger.warning("Unknown task completion report: %d", task_id)
                 return False
-            _, task = worker_and_task
+            owner, task = worker_and_task
+            if worker_id is not None and owner != worker_id:
+                logger.warning(
+                    "Stale report for task %d from worker %d "
+                    "(now owned by worker %d); ignoring",
+                    task_id,
+                    worker_id,
+                    owner,
+                )
+                return False
+            del self._doing[task_id]
+            if success and task.type == TaskType.TRAINING:
+                self._completed_records += task.end - task.start
             if not success:
                 n = self._retry_count.get(task_id, 0) + 1
                 self._retry_count[task_id] = n
@@ -169,6 +191,11 @@ class TaskDispatcher:
         if evaluation_task_completed is not None:
             self._evaluation_service.complete_task()
         return True
+
+    def completed_records(self) -> int:
+        """Cumulative records successfully trained (across epochs)."""
+        with self._lock:
+            return self._completed_records
 
     def recover_tasks(self, worker_id: int):
         """Requeue every in-flight task of a dead worker

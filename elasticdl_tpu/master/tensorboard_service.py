@@ -1,0 +1,135 @@
+"""Metrics / TensorBoard sink for the master.
+
+Re-design of the reference TensorBoard service
+(elasticdl/python/master/tensorboard_service.py:22-45, which wraps
+`tf.summary` writers and spawns a `tensorboard` subprocess): this
+framework is TF-free, so the writer backend is
+
+- `torch.utils.tensorboard.SummaryWriter` when importable (writes real
+  tfevents files TensorBoard can serve), else
+- a JSONL event log (`events.jsonl`: one `{"tag","value","step","ts"}`
+  per line) — always works, trivially machine-readable.
+
+The service exposes the two hook shapes the master wires up:
+`write_eval_metrics(version, metrics)` for the evaluation service's
+`metrics_writer` callback and `write_train_loss(version, loss)` for the
+servicer's per-version training-loss hook. The optional local
+`tensorboard --logdir` subprocess mirrors the reference's
+`tensorboard_service.py:35-45`; in k8s mode the LoadBalancer Service in
+front of it is created by `cluster.k8s_backend.create_tensorboard_service`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+
+class JsonlSummaryWriter:
+    """Append-only JSONL scalar log; the no-dependency fallback."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        self._path = os.path.join(logdir, "events.jsonl")
+        self._f = open(self._path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        with self._lock:
+            self._f.write(
+                json.dumps(
+                    {"tag": tag, "value": float(value), "step": int(step),
+                     "ts": time.time()}
+                )
+                + "\n"
+            )
+
+    def flush(self):
+        with self._lock:
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            self._f.close()
+
+
+def _make_writer(logdir: str, backend: str = "auto"):
+    if backend in ("auto", "torch"):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            return SummaryWriter(log_dir=logdir)
+        except Exception:
+            if backend == "torch":
+                raise
+    return JsonlSummaryWriter(logdir)
+
+
+class TensorBoardService:
+    """Scalar sink + optional local TensorBoard process."""
+
+    def __init__(self, logdir: str, backend: str = "auto"):
+        self.logdir = logdir
+        self._writer = _make_writer(logdir, backend)
+        self._tb_proc: Optional[subprocess.Popen] = None
+        logger.info(
+            "Metrics sink: %s -> %s",
+            type(self._writer).__name__,
+            logdir,
+        )
+
+    # -- hook shapes the master wires --------------------------------------
+
+    def write_eval_metrics(self, version: int, metrics: Dict[str, float]):
+        """EvaluationService `metrics_writer` callback."""
+        for name, value in metrics.items():
+            self._writer.add_scalar(f"eval/{name}", value, version)
+        self._writer.flush()
+
+    def write_train_loss(self, version: int, loss: float):
+        """Servicer per-version train-loss hook."""
+        self._writer.add_scalar("train/loss", loss, version)
+
+    def write_scalar(self, tag: str, value: float, step: int):
+        self._writer.add_scalar(tag, value, step)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start_tensorboard_process(self, port: int = 6006) -> bool:
+        """Spawn `tensorboard --logdir` like the reference
+        (tensorboard_service.py:35-45). Returns False when the binary
+        is unavailable (the summaries still land on disk)."""
+        try:
+            self._tb_proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "tensorboard.main",
+                    "--logdir", self.logdir,
+                    "--port", str(port),
+                    "--bind_all",
+                ],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            return True
+        except Exception:
+            logger.warning("tensorboard process unavailable; summaries on disk")
+            return False
+
+    def close(self):
+        self._writer.flush()
+        self._writer.close()
+        if self._tb_proc is not None:
+            self._tb_proc.terminate()
+            try:
+                self._tb_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._tb_proc.kill()

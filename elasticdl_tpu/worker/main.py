@@ -63,9 +63,41 @@ def main(argv=None) -> int:
         local_updates=args.local_updates,
         transport_dtype=args.transport_dtype,
     )
+    # device-level tracing (SURVEY §5.1): a jax.profiler trace of the
+    # whole task loop, viewable in TensorBoard/Perfetto/XProf. The
+    # PhaseTimers in the worker cover host-side attribution; this
+    # covers the XLA/device side.
+    # Graceful teardown: the master deletes worker pods/processes at
+    # job end (SIGTERM, then SIGKILL after a grace period). Convert
+    # SIGTERM into SystemExit so the finally block below still drains
+    # the final sync and closes the profiler trace.
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda s, f: sys.exit(143))
+
+    profiling = False
+    if args.profile_dir:
+        import jax
+
+        trace_dir = os.path.join(
+            args.profile_dir, f"worker-{args.worker_id}"
+        )
+        try:
+            jax.profiler.start_trace(trace_dir)
+            profiling = True
+            logger.info("jax.profiler trace -> %s", trace_dir)
+        except Exception:
+            logger.exception("profiler start failed; continuing untraced")
     try:
         clean = worker.run()
     finally:
+        if profiling:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                logger.exception("profiler stop failed")
         worker.close()
         client.close()
     return 0 if clean else EXIT_CODE_JOB_FAILED

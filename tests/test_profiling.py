@@ -1,0 +1,38 @@
+"""Device-trace profiling hook (SURVEY §5.1: the reference's timing
+study doc/worker_optimization_design.md:33-60 is host-side only; the
+jax.profiler trace adds the XLA/device side)."""
+
+import glob
+import os
+
+from elasticdl_tpu.master.main import main as master_main
+from elasticdl_tpu.testing import write_linear_records
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def test_worker_writes_device_trace(tmp_path):
+    tmp = str(tmp_path)
+    write_linear_records(os.path.join(tmp, "train.rio"), 64, seed=0)
+    profile_dir = os.path.join(tmp, "prof")
+    rc = master_main(
+        [
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--training_data_dir", os.path.join(tmp, "train.rio"),
+            "--records_per_task", "32",
+            "--num_epochs", "1",
+            "--grads_to_wait", "1",
+            "--num_workers", "1",
+            "--worker_backend", "process",
+            "--profile_dir", profile_dir,
+        ]
+    )
+    assert rc == 0
+    traces = glob.glob(
+        os.path.join(profile_dir, "worker-0", "**", "*"), recursive=True
+    )
+    assert any(os.path.isfile(t) for t in traces), (
+        f"no trace files under {profile_dir}"
+    )

@@ -94,3 +94,30 @@ def test_prediction_only():
     d = TaskDispatcher({}, {}, {"p": 15}, 10, 1)
     t = d.get(0)
     assert t.type == TaskType.PREDICTION
+
+
+def test_stale_report_from_previous_owner_rejected():
+    """A worker whose failed-sync path already reported a task must not
+    pop the requeued task from its NEW owner (ADVICE r2: duplicate
+    report inflating retries / double-training the shard)."""
+    d = make(shards={"f1": 10}, rpt=10)
+    t = d.get(worker_id=0)
+    # worker 0's sync failure reports the task as failed -> requeued
+    assert d.report(t.task_id, False, worker_id=0) is True
+    # worker 1 claims the requeued shard
+    t2 = d.get(worker_id=1)
+    assert t2.task_id == t.task_id
+    # worker 0's stale duplicate report must be rejected...
+    assert d.report(t.task_id, True, worker_id=0) is False
+    assert not d.finished()
+    # ...while the rightful owner's report completes the job
+    assert d.report(t.task_id, True, worker_id=1) is True
+    assert d.finished()
+
+
+def test_report_without_worker_id_still_accepted():
+    """Legacy/anonymous reports (no worker_id) keep working."""
+    d = make(shards={"f1": 10}, rpt=10)
+    t = d.get(worker_id=0)
+    assert d.report(t.task_id, True) is True
+    assert d.finished()
