@@ -23,6 +23,7 @@ from __future__ import annotations
 import inspect
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, NamedTuple, Optional
 
 import jax
@@ -119,10 +120,19 @@ class Worker:
         self._base_flat = None  # device copy of params at last sync
         self._base_version = -1
         self._pending_steps = 0
-        self._sync_thread = None  # in-flight async delta push
-        self._sync_result = None  # (version, params_flat, aux) from it
+        self._sync_thread = None  # tail of the chained async delta pushes
+        self._sync_inflight: "deque" = deque()  # running sync threads
+        self._max_inflight_syncs = 2  # pipeline depth (windows in flight)
+        self._sync_seq = 0  # spawn counter: tags piggyback results
+        self._synced_seq = 0  # highest seq whose delta landed on the PS
+        self._sync_epoch = 0  # bumped on reset: invalidates spawned syncs
+        self._sync_result = None  # (seq, params_flat, aux) piggyback
+        self._base_snapshots: Dict[int, Any] = {}  # seq -> base at spawn
         self._sync_error = None  # exception raised by the async push
+        self._pending_losses: list = []  # (task_id|None, device scalar)
+        self._latest_step_loss = None  # device scalar of the newest step
         self._deferred_reports: list = []  # task results gated on sync
+        self._flushed_report_ids: set = set()  # ids already reported by a flush
         self._report_lock = threading.Lock()  # main + sync threads
         self._job_failed = False  # master reported partial completion
         self.last_loss = None  # final minibatch loss of the last task
@@ -226,21 +236,35 @@ class Worker:
             },
         )
 
-    def report_gradient(self, grads, edl_grads, aux_state, flat: bool = False):
+    def report_gradient(
+        self, grads, edl_grads, aux_state, flat: bool = False, loss=None
+    ):
+        """Returns (response, loss_value). ONE batched d2h round
+        (device_get) moves gradient + aux + loss together — per-item
+        np.asarray costs a full round-trip each over a high-latency
+        device link."""
+        grads_h, aux_h, loss_h = jax.device_get(
+            (grads, aux_state or None, loss)
+        )
         req = {
             "worker_id": self._id,
             "version": self._version,
             "edl_gradient": edl_grads or None,
-            # device_get batches d2h copies; per-leaf np.asarray costs a
-            # round-trip per leaf over a high-latency device link
-            "aux_state": jax.device_get(aux_state) if aux_state else None,
+            "aux_state": aux_h,
         }
+        if loss_h is not None:
+            req["loss"] = float(loss_h)  # feeds the master's metrics sink
         if flat:
-            req["gradient_flat"] = self._to_wire_dtype(grads)
+            # already bf16-cast on device by the step fn when requested
+            req["gradient_flat"] = grads_h
             req["return_model"] = True
+            if self._transport_dtype == "bfloat16":
+                # ask for the piggybacked model in bf16 too: halves the
+                # response h2d bytes on the per-step critical path
+                req["model_dtype"] = "bfloat16"
         else:
-            req["gradient"] = jax.tree_util.tree_map(self._to_wire_dtype, grads)
-        return self._master.call("ReportGradient", req)
+            req["gradient"] = jax.tree_util.tree_map(self._to_wire_dtype, grads_h)
+        return self._master.call("ReportGradient", req), loss_h
 
     def _to_wire_dtype(self, g):
         g = np.asarray(g)
@@ -254,7 +278,8 @@ class Worker:
 
     def report_task_result(self, task_id: int, err: str = ""):
         self._master.call(
-            "ReportTaskResult", {"task_id": task_id, "err_message": err}
+            "ReportTaskResult",
+            {"task_id": task_id, "err_message": err, "worker_id": self._id},
         )
 
     # ------------------------------------------------------- embedding plane
@@ -312,7 +337,9 @@ class Worker:
             try:
                 sig = inspect.signature(self._spec.model.__call__)
                 self._model_takes_train_kwarg = "train" in sig.parameters
-            except (TypeError, ValueError):  # pragma: no cover
+            except (TypeError, ValueError, AttributeError):
+                # duck-typed init/apply adapters (e.g. the functional
+                # transformer zoo entry) have no __call__
                 self._model_takes_train_kwarg = False
         return self._model_takes_train_kwarg
 
@@ -523,16 +550,22 @@ class Worker:
         local paths: absorb any in-flight sync, (re)pull or lazily init
         the model, and (re)initialize the on-device optimizer state."""
         if self._pending_steps == 0:
-            with self.timers.phase("sync_wait"):
-                self._join_sync()  # absorb any async sync before rebasing
+            # non-blocking: surface chain errors and absorb any landed
+            # piggyback rebase, but do NOT join — in-flight syncs
+            # overlap the next window's h2d + compute (pipeline)
+            self._check_sync_error()
+            self._absorb_sync_result()
         if self._pending_steps == 0 and (
             not self._fresh or self._version < task.model_version
         ):
-            if not self.pull_model(max(self._version, task.model_version)):
-                self._init_model(features, None)
-                self.report_variable()
-                self.pull_model()
-            self._opt_state = None  # params swapped: restart opt state
+            with self.timers.phase("sync_wait"):
+                self._join_sync()  # model swap: settle the chain first
+            if not self._fresh or self._version < task.model_version:
+                if not self.pull_model(max(self._version, task.model_version)):
+                    self._init_model(features, None)
+                    self.report_variable()
+                    self.pull_model()
+                self._opt_state = None  # params swapped: restart opt state
         if self._opt_state is None:
             with self.timers.phase("rebase"):
                 tx = self._spec.optimizer()
@@ -549,6 +582,7 @@ class Worker:
         )
         self._aux = new_aux or self._aux
         self._pending_steps += 1
+        self._latest_step_loss = loss
         if self._pending_steps >= self._local_updates:
             # async: the delta d2h + RPC ride a background thread while
             # the device starts the next window (double-buffering)
@@ -602,6 +636,7 @@ class Worker:
         )
         self._aux = new_aux or self._aux
         self._pending_steps += self._local_updates
+        self._latest_step_loss = loss
         self._sync_local_updates(blocking=False)
         return loss
 
@@ -634,6 +669,11 @@ class Worker:
                         labs = jax.tree_util.tree_map(
                             lambda *xs: np.stack(xs), *[b[1] for b in buf]
                         )
+                        # NOTE: an explicit async jax.device_put here
+                        # measured SLOWER end-to-end on the remote-TPU
+                        # tunnel (transfers contend; the link performs
+                        # best serialized), so the h2d rides the jit
+                        # dispatch
                         loss = self._local_window(feats, labs, task)
                     else:
                         for f, l in buf:
@@ -644,44 +684,103 @@ class Worker:
     def _sync_local_updates(self, blocking: bool = True):
         """Push the cumulative delta: one d2h + one RPC per window.
 
-        With blocking=False the d2h and the RPC ride a background
-        thread and the device keeps training the next window — the sync
-        leaves the critical path entirely. Elastic semantics are
+        With blocking=False syncs CHAIN on background threads — each
+        thread joins its predecessor, so deltas land on the PS in
+        dispatch order while the main thread never blocks on the
+        device link. Up to `_max_inflight_syncs` windows ride the link
+        concurrently with the next windows' feature h2d and the device
+        compute (the link, not the MXU, is the bottleneck on a
+        high-latency host<->TPU tunnel). Elastic semantics are
         preserved by deferring ReportTaskResult until the covering sync
         lands (`_defer_report`): work that dies unsynced dies
         unreported, so the dispatcher requeues it."""
-        self._join_sync()
+        if blocking:
+            self._join_sync()
+        else:
+            self._check_sync_error()
+            self._absorb_sync_result()
         if not self._pending_steps:
-            self._flush_deferred_reports()
+            if blocking:
+                self._flush_deferred_reports()
             return
         delta_dev = self._flat - self._base_flat  # own buffer, thread-safe
+        if self._transport_dtype == "bfloat16" and _BF16 is not None:
+            # cast on DEVICE: halves the per-window d2h bytes
+            delta_dev = delta_dev.astype(jnp.bfloat16)
         steps = self._pending_steps
-        base_version = self._base_version
         aux_dev = self._aux  # device refs; materialized in the thread
+        losses = self._pending_losses  # resolved in the same d2h round
+        self._pending_losses = []
+        # the delta's OWN newest step loss — feeds the master's metrics
+        # sink attributed to the version this delta produces (task-end
+        # losses in `losses` can belong to earlier windows)
+        step_loss = self._latest_step_loss
         self._base_flat = jnp.copy(self._flat)
         self._pending_steps = 0
+        prev = self._sync_thread
+        with self._report_lock:
+            self._sync_seq += 1
+            seq, epoch = self._sync_seq, self._sync_epoch
+            # snapshot of the local params this delta brings the PS up
+            # to — the anchor for absorbing this sync's piggybacked
+            # merged model while younger deltas are still in flight
+            self._base_snapshots[seq] = self._base_flat
 
         def do_sync():
-            # jax.device_get batches the copies (one async round) —
-            # per-leaf np.asarray costs a device round-trip per leaf,
-            # which over a high-latency host<->TPU link dwarfs the
-            # transfer itself. Materializing here also keeps the d2h
-            # wait off the main thread's dispatch path entirely.
-            aux_host = jax.device_get(aux_dev) if aux_dev else None
-            resp = self._master.call(
-                "ReportLocalUpdate",
-                {
-                    "delta_flat": self._to_wire_dtype(np.asarray(delta_dev)),
-                    "steps": steps,
-                    "base_version": base_version,
-                    "aux_state": aux_host,
-                },
+            if prev is not None:
+                prev.join()
+            with self._report_lock:
+                if self._sync_error is not None or epoch != self._sync_epoch:
+                    # chain broken (a predecessor failed) or the main
+                    # thread already reset local state: our delta's base
+                    # never reached the PS — do NOT send it, do NOT
+                    # touch worker state, do NOT flush reports.
+                    return
+            # ONE batched d2h round (device_get) for delta + aux + the
+            # window's task losses — per-item np.asarray would cost a
+            # full round-trip each over a high-latency host<->TPU link.
+            delta_h, aux_h, loss_h, step_loss_h = jax.device_get(
+                (delta_dev, aux_dev or None, [l for _, l in losses], step_loss)
             )
-            self._sync_result = (
-                resp["version"],
-                resp.get("params_flat"),
-                resp.get("aux"),
-            )
+            with self._report_lock:
+                base_version = self._base_version
+            req = {
+                "delta_flat": delta_h,
+                "steps": steps,
+                "base_version": base_version,
+                "aux_state": aux_h,
+            }
+            if step_loss_h is not None:
+                req["loss"] = float(step_loss_h)  # master's metrics sink
+            resp = self._master.call("ReportLocalUpdate", req)
+            with self._report_lock:
+                if epoch != self._sync_epoch:
+                    return  # reset raced the RPC: discard the response
+                self._synced_seq = max(self._synced_seq, seq)
+                self._version = resp["version"]
+                self._base_version = resp["version"]
+                self._fresh = True
+                if resp.get("params_flat") is not None:
+                    # device-side rebase must run on the main thread;
+                    # tagged with seq so the absorb can anchor the
+                    # merged model to this delta's base snapshot (a
+                    # newer result supersedes an unabsorbed older one)
+                    self._sync_result = (
+                        seq,
+                        resp["params_flat"],
+                        resp.get("aux"),
+                    )
+                # drop base snapshots this sync has settled — keep only
+                # the one a still-pending piggyback result anchors to
+                pending = (
+                    self._sync_result[0]
+                    if self._sync_result is not None
+                    else None
+                )
+                for k in list(self._base_snapshots):
+                    if k <= seq and k != pending:
+                        del self._base_snapshots[k]
+            self._record_synced_losses(losses, loss_h, resp["version"])
             self._flush_deferred_reports()
 
         if blocking:
@@ -699,22 +798,51 @@ class Worker:
             def thread_main():
                 try:
                     do_sync()
-                except Exception as e:  # joined + re-raised in _join_sync
+                except Exception as e:  # surfaced by _check_sync_error
                     self._sync_error = e
 
-            self._sync_thread = threading.Thread(target=thread_main, daemon=True)
-            self._sync_thread.start()
+            t = threading.Thread(target=thread_main, daemon=True)
+            self._sync_thread = t
+            self._sync_inflight.append(t)
+            t.start()
+            # backpressure: bound in-flight windows (device memory for
+            # their feature buffers + requeue exposure on preemption)
+            while len(self._sync_inflight) > self._max_inflight_syncs:
+                with self.timers.phase("sync_wait"):
+                    self._sync_inflight.popleft().join()
 
-    def _join_sync(self):
-        """Wait for an in-flight async sync and absorb its result."""
-        if self._sync_thread is not None:
-            self._sync_thread.join()
-            self._sync_thread = None
+    def _record_synced_losses(self, losses, loss_h, version):
+        """Task losses resolve on the sync thread (batched with the
+        delta d2h) so the main thread never blocks on a device scalar."""
+        for (task_id, _), v in zip(losses, loss_h):
+            self.last_loss = float(v)
+            self.task_losses.append(self.last_loss)
+            if task_id is not None:
+                logger.info(
+                    "Worker %d task %d done (last loss %.4f, v%d) [%s]",
+                    self._id,
+                    task_id,
+                    self.last_loss,
+                    version,
+                    self.timers.summary(),
+                )
+
+    def _check_sync_error(self):
+        """Surface a failed chained sync: every task whose report is
+        still deferred gets requeued, and local state resets."""
         if self._sync_error is not None:
             err, self._sync_error = self._sync_error, None
             self._flush_deferred_reports(err=f"sync failed: {err}")
             self._reset_local_state()
             raise RuntimeError(f"local-update sync failed: {err}") from err
+
+    def _join_sync(self):
+        """Wait for the whole in-flight sync chain and absorb results."""
+        if self._sync_thread is not None:
+            self._sync_thread.join()  # tail of the chain: joins them all
+            self._sync_thread = None
+        self._sync_inflight.clear()
+        self._check_sync_error()
         self._absorb_sync_result()
 
     def _reset_local_state(self):
@@ -723,46 +851,99 @@ class Worker:
         lost tasks get re-trained on top of the phantom delta). Drop
         everything local and force a full model re-pull: version -1
         defeats the `only_if_newer` pull optimisation even when the PS
-        version did not advance."""
-        self._fresh = False
-        self._version = -1
+        version did not advance. Bumping the sync epoch makes every
+        already-spawned chained sync a no-op (their deltas build on the
+        state being discarded here)."""
+        with self._report_lock:
+            self._sync_epoch += 1
+            self._fresh = False
+            self._version = -1
+            self._sync_result = None
+            self._base_snapshots.clear()
         self._opt_state = None
         self._pending_steps = 0
-        self._sync_result = None
+        self._pending_losses = []
 
     def _absorb_sync_result(self):
-        if self._sync_result is None:
-            return
-        version, params_flat, aux = self._sync_result
-        self._sync_result = None
-        self._version = version
-        if params_flat is not None:
-            # another worker advanced the PS: rebase — merged model plus
-            # our still-unsynced local steps (local-SGD merge)
+        """Apply a piggybacked merged model (another worker advanced
+        the PS) — device ops, main thread only. Version bookkeeping
+        already happened on the sync thread under the lock.
+
+        The merged model from sync i reflects the PS AFTER our delta i
+        but WITHOUT our still-in-flight younger deltas, so it cannot
+        simply replace the local base: instead shift the local params
+        by (merged_i - base_snapshot_i). Deltas are differences, so the
+        shift leaves every in-flight and future delta's content intact
+        while folding the other workers' progress into our trajectory
+        (local-SGD merge).
+
+        The still-pending YOUNGER snapshots must be shifted too: they
+        were recorded before this absorb, so without the shift the next
+        absorb's (merged_{i+1} - snap_{i+1}) would re-contain shift_i
+        and other workers' progress would be applied twice."""
+        with self._report_lock:
+            res = self._sync_result
+            if res is None:
+                return
+            seq, params_flat, aux = res
+            self._sync_result = None
+            snap = self._base_snapshots.get(seq)
+            for k in [k for k in self._base_snapshots if k <= seq]:
+                del self._base_snapshots[k]
+            if snap is None:
+                return  # reset raced the response: state discarded
             merged = jnp.asarray(np.asarray(params_flat, dtype=np.float32))
-            self._flat = merged + (self._flat - self._base_flat)
-            self._base_flat = merged
-            if aux:
-                self._aux = jax.tree_util.tree_map(jnp.asarray, aux)
-        self._base_version = version
-        self._fresh = True
+            shift = merged - snap
+            for k in list(self._base_snapshots):  # younger, unsettled
+                self._base_snapshots[k] = self._base_snapshots[k] + shift
+        self._flat = self._flat + shift
+        self._base_flat = self._base_flat + shift
+        if aux:
+            self._aux = jax.tree_util.tree_map(jnp.asarray, aux)
 
     def _defer_report(self, task_id: int, err: str):
+        """Queue the task's result behind its COVERING sync: the last
+        already-spawned sync when the task ended on a window boundary,
+        else the tail sync the caller is about to spawn (seq+1)."""
         with self._report_lock:
-            self._deferred_reports.append((task_id, err))
+            cover = self._sync_seq + (1 if self._pending_steps else 0)
+            self._deferred_reports.append((task_id, err, cover))
 
     def _flush_deferred_reports(self, err: Optional[str] = None):
-        """Report deferred task results; `err` overrides each entry's
-        own message (used when the covering sync failed, so the
-        dispatcher requeues the window's tasks)."""
+        """Report deferred task results whose covering sync has landed
+        on the PS. With `err` set (the sync chain broke) ALL entries
+        flush: covered ones with their own result (their data landed),
+        uncovered ones as failures so the dispatcher requeues them —
+        an entry must never report success while its tail delta is
+        still riding a younger in-flight sync.
+
+        Each flushed id is recorded so `run()` never re-reports a task
+        whose report was already handled here: a failed-sync flush can
+        fire for the current task and THEN raise, and the duplicate
+        report from run()'s except path would pop the (requeued,
+        possibly re-claimed) task from the dispatcher's doing-map."""
         while True:
             with self._report_lock:
-                if not self._deferred_reports:
+                entry = None
+                for i, (task_id, own_err, cover) in enumerate(
+                    self._deferred_reports
+                ):
+                    covered = cover <= self._synced_seq
+                    if covered or err is not None:
+                        entry = (task_id, own_err, covered)
+                        del self._deferred_reports[i]
+                        break
+                if entry is None:
                     return
-                task_id, own_err = self._deferred_reports.pop(0)
+                task_id, own_err, covered = entry
+                self._flushed_report_ids.add(task_id)
             self._master.call(
                 "ReportTaskResult",
-                {"task_id": task_id, "err_message": err or own_err},
+                {
+                    "task_id": task_id,
+                    "err_message": own_err if covered else (err or own_err),
+                    "worker_id": self._id,
+                },
             )
 
     def _process_minibatch(self, features, labels, task: Task) -> float:
@@ -802,15 +983,14 @@ class Worker:
             }
             flat = self._use_flat()
             with self.timers.phase("report_gradient"):
-                resp = self.report_gradient(
-                    np.asarray(gparams) if flat else gparams,
-                    edl_grads,
-                    new_aux,
-                    flat=flat,
+                # device arrays go straight into the batched d2h inside
+                # report_gradient (gradient + aux + loss in one round)
+                resp, loss_h = self.report_gradient(
+                    gparams, edl_grads, new_aux, flat=flat, loss=loss
                 )
             self._absorb_report_response(resp)
             if resp["accepted"]:
-                return float(loss)
+                return float(loss_h)
         raise RuntimeError("worker stuck: minibatch retries exhausted")
 
     def _absorb_report_response(self, resp):
@@ -868,31 +1048,35 @@ class Worker:
                         loss = self._local_minibatch(features, labels, task)
                     else:
                         loss = self._process_minibatch(features, labels, task)
-        # resolving the loss blocks on every window the task dispatched;
-        # timing it keeps the phase breakdown summing to wall clock
-        # (device execution otherwise hides in an untimed float())
-        with self.timers.phase("device_wait"):
-            self.last_loss = float(loss)
-        self.task_losses.append(self.last_loss)
         deferred = False
         if self._local_updates:
-            # async sync at the task boundary; the task's result report
-            # is deferred until this sync lands (elastic correctness:
-            # unsynced work must look unfinished to the dispatcher, so a
-            # worker preempted before the sync gets its data requeued).
-            # Defer BEFORE starting the sync so its flush covers us.
+            # Loss resolution + the completion log ride a sync thread's
+            # batched d2h — the main thread never blocks on a device
+            # scalar, so windows/tasks pipeline through the device link.
+            # The task's result report is deferred until a covering sync
+            # lands (elastic correctness: unsynced work must look
+            # unfinished to the dispatcher, so a worker preempted before
+            # the sync gets its data requeued). Defer BEFORE any spawn
+            # below so its flush covers us.
+            if loss is not None:  # a zero-batch task has no loss
+                self._pending_losses.append((task.task_id, loss))
             self._defer_report(task.task_id, "")
             deferred = True
-            with self.timers.phase("sync_wait"):
-                self._sync_local_updates(blocking=False)
-        logger.info(
-            "Worker %d task %d done (last loss %.4f, v%d) [%s]",
-            self._id,
-            task.task_id,
-            float(loss),
-            self._version,
-            self.timers.summary(),
-        )
+            self._sync_local_updates(blocking=False)  # push any ragged tail
+        else:
+            # resolving the loss blocks on the dispatched steps; timing
+            # it keeps the phase breakdown summing to wall clock
+            with self.timers.phase("device_wait"):
+                self.last_loss = float(loss)
+            self.task_losses.append(self.last_loss)
+            logger.info(
+                "Worker %d task %d done (last loss %.4f, v%d) [%s]",
+                self._id,
+                task.task_id,
+                self.last_loss,
+                self._version,
+                self.timers.summary(),
+            )
         return deferred
 
     def _process_evaluation_task(self, task: Task):
@@ -987,7 +1171,9 @@ class Worker:
         out = self._local_window_fn(
             jnp.copy(self._flat), opt_state, self._aux, features, labels
         )
-        jax.block_until_ready(out)
+        # a d2h of the loss forces true completion even where
+        # block_until_ready returns early (remote-device tunnels)
+        jax.device_get(out[3])
 
     def warmup_sync_step(self, features, labels):
         """AOT warm-up of the per-step sync path for [B, ...] shapes:
@@ -1000,7 +1186,7 @@ class Worker:
         out = self._train_step(
             self._step_params(), self._aux, {}, features, labels
         )
-        jax.block_until_ready(out)
+        jax.device_get(out[0])
 
     def _warmup_params(self, features):
         """Ensure params exist (pull from the PS or lazily init it)."""
@@ -1036,10 +1222,19 @@ class Worker:
                     logger.info("Worker %d: job finished, exiting", self._id)
                     return True
                 with self.timers.phase("wait_poll"):
-                    time.sleep(0.2)
+                    time.sleep(0.05)
                 continue
             err = ""
             reported = False
+            with self._report_lock:
+                # The flushed-id set exists solely so THIS iteration's
+                # end can tell "my report was already handled by a
+                # failed-sync flush". Any entry present before the
+                # iteration starts is stale — either a success flush
+                # that landed after its own task's turn, or a leftover
+                # from an earlier claim of this same requeued id (which
+                # must not suppress this episode's failure report).
+                self._flushed_report_ids.clear()
             # `task_other` is charged only the EXCLUSIVE remainder:
             # PhaseTimers subtracts nested phases, so the breakdown sums
             # to the run loop's true wall clock (VERDICT r2 weak #2)
@@ -1058,7 +1253,10 @@ class Worker:
                         "Worker %d task %d failed", self._id, task.task_id
                     )
                     err = f"{type(e).__name__}: {e}"
-                if not reported:
+                with self._report_lock:
+                    flushed = task.task_id in self._flushed_report_ids
+                    self._flushed_report_ids.discard(task.task_id)
+                if not reported and not flushed:
                     self.report_task_result(task.task_id, err)
 
     def _finalize_local_updates(self):
@@ -1072,6 +1270,11 @@ class Worker:
         self._join_sync()
         if self._pending_steps:
             self._sync_local_updates(blocking=True)
+        if self._pending_losses:
+            # losses whose covering sync already ran (exact-fit windows)
+            losses, self._pending_losses = self._pending_losses, []
+            loss_h = jax.device_get([l for _, l in losses])
+            self._record_synced_losses(losses, loss_h, self._version)
         self._flush_deferred_reports()
 
     def close(self):
