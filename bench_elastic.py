@@ -1,0 +1,240 @@
+"""Elastic-throughput-retention benchmark — the north-star metric.
+
+BASELINE.md's target for this framework is *throughput retention under
+50% worker preemption* (>=95% on a preemptible TPU pool). This bench
+measures exactly that, in process-mode on CPU so it runs anywhere:
+
+1. **stable run**: N worker subprocesses train a model-zoo conv net
+   through the real master (gRPC PS + dispatcher + WorkerManager),
+   and we measure steady-state images/sec from the dispatcher's
+   completed-record counter — the clock starts at the first completed
+   task, so worker boot (python + jax import + compile) is excluded
+   from BOTH runs identically.
+2. **churn run**: same job, but once 25% of the records are trained,
+   HALF the workers are SIGKILLed (a real preemption: no cleanup, no
+   final sync). The WorkerManager must detect the deaths, requeue
+   their in-flight shards, and relaunch replacements; throughput is
+   measured over the whole post-warmup window, relaunch transient
+   included.
+
+    retention = churn_images_per_sec / stable_images_per_sec
+
+The run fails loudly if the churn job does not complete, drops tasks,
+or never relaunches. Prints ONE JSON line:
+
+  {"metric": "elastic_throughput_retention_50pct_kill", "value": R,
+   "unit": "ratio", "stable_images_per_sec": ..., "churn_images_per_sec": ...,
+   "relaunches": ..., "target": 0.95}
+
+Reference: the procedure `kubectl delete pod` + watch recovery that the
+reference only documents manually (elasticdl/doc/elastic_scheduling.md);
+BASELINE.md "throughput retention under 50% worker preemption".
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+# everything on CPU: N worker processes can't share the one TPU chip
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_WORKERS = int(os.environ.get("EDL_ELASTIC_BENCH_WORKERS", 2))
+KILL_FRACTION = 0.5
+KILL_AT_PROGRESS = 0.25
+MINIBATCH = 64
+RECORDS_PER_TASK = 512  # = one full 8-step window per task (no ragged
+# tails -> exactly one compiled program per worker)
+LOCAL_UPDATES = 8  # window mode: the per-step RPC path would measure
+# the PS lock, not elasticity, with 4 workers on one host
+# mnist (light conv) rather than cifar: the CI/bench host can be a
+# single core, and the subject here is the elastic RUNTIME — relaunch,
+# requeue, warm restart — not MXU throughput (bench.py covers that)
+MODEL_DEF = "mnist_functional_api.custom_model"
+IMAGE_SHAPE = (28, 28, 1)
+
+
+def _write_data(tmp, n_records):
+    from elasticdl_tpu.models.record_codec import write_synthetic_image_records
+
+    per_shard = n_records // 4
+    assert per_shard % RECORDS_PER_TASK == 0, "shards must be whole tasks"
+    for i in range(4):
+        write_synthetic_image_records(
+            os.path.join(tmp, f"shard-{i}.rio"),
+            per_shard,
+            IMAGE_SHAPE,
+            10,
+            seed=i,
+        )
+
+
+def run_job(data_dir, n_records, *, churn: bool, epochs: int, cache_dir: str):
+    from elasticdl_tpu.cluster.pod_backend import ProcessBackend
+    from elasticdl_tpu.common.args import master_parser, worker_forward_args
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.master.worker_manager import WorkerManager
+    from elasticdl_tpu.rpc.server import RpcServer
+
+    args = master_parser().parse_args(
+        [
+            "--model_zoo", os.path.join(os.path.dirname(__file__), "elasticdl_tpu", "models"),
+            "--model_def", MODEL_DEF,
+            "--minibatch_size", str(MINIBATCH),
+            "--training_data_dir", data_dir,
+            "--records_per_task", str(RECORDS_PER_TASK),
+            "--num_epochs", str(epochs),
+            "--grads_to_wait", "1",
+            "--local_updates", str(LOCAL_UPDATES),
+            "--num_workers", str(N_WORKERS),
+            "--worker_backend", "process",
+        ]
+    )
+    spec, dispatcher, servicer, _, _ = build_master(args, "training")
+    server = RpcServer(servicer.handlers(), port=0)
+    server.start()
+    addr = f"localhost:{server.port}"
+    backend = ProcessBackend(
+        log_dir=os.path.join(data_dir, "logs-churn" if churn else "logs-stable")
+    )
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=N_WORKERS,
+        worker_argv_fn=lambda wid: worker_forward_args(args, wid, addr),
+        envs={
+            "JAX_PLATFORMS": "cpu",
+            **(
+                {
+                    "JAX_COMPILATION_CACHE_DIR": cache_dir,
+                    # cache every program regardless of compile time
+                    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+                }
+                if cache_dir
+                else {}
+            ),
+        },
+        max_relaunches=2 * N_WORKERS,
+    )
+    total = n_records * epochs
+    kill_at = int(total * KILL_AT_PROGRESS)
+    n_kill = int(N_WORKERS * KILL_FRACTION)
+    manager.start_workers()
+    t0 = c0 = None
+    killed = False
+    try:
+        deadline = time.time() + 1800
+        while not dispatcher.finished():
+            if time.time() > deadline:
+                raise RuntimeError("job did not finish in 30 min")
+            if manager.all_exited():
+                raise RuntimeError("all workers exited with tasks left")
+            done = dispatcher.completed_records()
+            if t0 is None and done > 0:
+                # steady-state clock: starts at first completed task so
+                # initial worker boot is excluded from both runs
+                t0, c0 = time.time(), done
+            if churn and not killed and done >= kill_at:
+                for wid in range(n_kill):
+                    pid = backend.pid_of(wid)
+                    if pid:
+                        os.kill(pid, signal.SIGKILL)
+                killed = True
+                print(
+                    f"bench_elastic: killed {n_kill}/{N_WORKERS} workers "
+                    f"at {done}/{total} records",
+                    file=sys.stderr,
+                )
+            time.sleep(0.05)
+        elapsed = time.time() - t0
+        processed = dispatcher.completed_records() - c0
+        assert not dispatcher.has_failed_tasks(), "job dropped tasks"
+        if churn:
+            assert killed, "churn run finished before the kill point"
+            assert manager.relaunches() >= 1, "no worker was relaunched"
+        return processed / elapsed, manager.relaunches()
+    finally:
+        manager.stop_relaunch_and_remove_workers()
+        backend.stop()
+        server.stop()
+
+
+def main():
+    # defaults sized for a single-core CI host (the 4 worker processes
+    # + master share whatever cores exist; see the protocol note)
+    n_records = int(os.environ.get("EDL_ELASTIC_BENCH_RECORDS", 4096))
+    epochs = int(os.environ.get("EDL_ELASTIC_BENCH_EPOCHS", 2))
+    tmp = tempfile.mkdtemp(prefix="edl_elastic_bench_")
+    _write_data(tmp, n_records)
+    print(
+        f"bench_elastic: {n_records} records x {epochs} epochs, "
+        f"{N_WORKERS} workers, kill {int(N_WORKERS * KILL_FRACTION)} at "
+        f"{int(KILL_AT_PROGRESS * 100)}%",
+        file=sys.stderr,
+    )
+    # Fast worker recovery via a persistent XLA compile cache
+    # (JAX_COMPILATION_CACHE_DIR) is how production deployments make a
+    # relaunched replacement restart in seconds instead of re-paying
+    # the jit compile. Opt-in here (EDL_ELASTIC_BENCH_CACHE=1): on this
+    # image the XLA:CPU AOT reload path is slower than recompiling
+    # (machine-feature mismatch warnings + slow loads), so by default
+    # the retention number honestly includes the full recompile cost
+    # of each relaunched worker.
+    cache_dir = ""
+    if os.environ.get("EDL_ELASTIC_BENCH_CACHE") == "1":
+        cache_dir = os.path.join(tmp, "xla-cache")
+        warm_dir = os.path.join(tmp, "warm")
+        os.makedirs(warm_dir)
+        _write_data(warm_dir, 4 * RECORDS_PER_TASK)  # one task per worker
+        t0 = time.time()
+        run_job(
+            warm_dir, 4 * RECORDS_PER_TASK, churn=False, epochs=1,
+            cache_dir=cache_dir,
+        )
+        print(
+            f"bench_elastic: cache warm-up done in {time.time() - t0:.0f}s",
+            file=sys.stderr,
+        )
+    stable_ips, _ = run_job(
+        tmp, n_records, churn=False, epochs=epochs, cache_dir=cache_dir
+    )
+    print(f"bench_elastic: stable {stable_ips:.1f} img/s", file=sys.stderr)
+    churn_ips, relaunches = run_job(
+        tmp, n_records, churn=True, epochs=epochs, cache_dir=cache_dir
+    )
+    print(
+        f"bench_elastic: churn {churn_ips:.1f} img/s "
+        f"({relaunches} relaunches)",
+        file=sys.stderr,
+    )
+    retention = churn_ips / stable_ips
+    print(
+        json.dumps(
+            {
+                "metric": "elastic_throughput_retention_50pct_kill",
+                "value": round(retention, 3),
+                "unit": "ratio",
+                "stable_images_per_sec": round(stable_ips, 1),
+                "churn_images_per_sec": round(churn_ips, 1),
+                "relaunches": relaunches,
+                "target": 0.95,
+                "protocol": (
+                    f"{N_WORKERS} process workers (CPU), SIGKILL "
+                    f"{int(KILL_FRACTION * 100)}% at "
+                    f"{int(KILL_AT_PROGRESS * 100)}% progress; throughput "
+                    "clocked from first completed task (worker boot "
+                    "excluded identically in both runs); relaunch "
+                    "transient INCLUDING each replacement's full "
+                    "python+jax+compile boot is charged against churn "
+                    "throughput (production deployments amortize it via "
+                    "the persistent XLA cache, EDL_ELASTIC_BENCH_CACHE=1)"
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
