@@ -1,0 +1,54 @@
+"""Lazy compile-and-load for the framework's C++ components.
+
+The native pieces (RecordIO indexer, embedding KV store) ship as
+single-file C++ sources compiled on first use with the host toolchain
+and loaded over ctypes — no build step, no wheels, and a pure-Python
+fallback wherever g++ is missing. This helper owns the once-only
+compile/load/cache logic so every native component shares one
+implementation of the staleness check and failure path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, Optional
+
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+_lock = threading.Lock()
+_cache: Dict[str, Optional[ctypes.CDLL]] = {}  # so path -> lib (or None)
+
+
+def compile_and_load(
+    src: str,
+    so: str,
+    configure: Callable[[ctypes.CDLL], None],
+    what: str = "native library",
+) -> Optional[ctypes.CDLL]:
+    """Compile `src` into `so` (if missing or older than the source),
+    load it, apply `configure(lib)` (restype/argtypes), cache by path.
+    Returns None — once, with a warning — when the toolchain or load
+    fails; callers fall back to their Python path."""
+    with _lock:
+        if so in _cache:
+            return _cache[so]
+        try:
+            if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+                os.makedirs(os.path.dirname(so), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", so],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(so)
+            configure(lib)
+            _cache[so] = lib
+        except Exception as e:  # pragma: no cover - toolchain missing
+            logger.warning("%s unavailable (%s); using Python path", what, e)
+            _cache[so] = None
+        return _cache[so]

@@ -19,8 +19,6 @@ import ctypes
 import mmap
 import os
 import struct
-import subprocess
-import threading
 import zlib
 from typing import Iterator, List, Optional, Tuple
 
@@ -32,46 +30,31 @@ logger = get_logger(__name__)
 
 _HEADER = struct.Struct("<II")
 
-_native_lock = threading.Lock()
-_native_lib: Optional[ctypes.CDLL] = None
-_native_tried = False
+def _configure(lib: ctypes.CDLL):
+    lib.edlrio_count.restype = ctypes.c_int64
+    lib.edlrio_count.argtypes = [ctypes.c_char_p]
+    lib.edlrio_index.restype = ctypes.c_int64
+    lib.edlrio_index.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+    ]
+    lib.edlrio_verify.restype = ctypes.c_int64
+    lib.edlrio_verify.argtypes = [ctypes.c_char_p]
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
     """Compile (once) and load the C++ indexer; None on failure."""
-    global _native_lib, _native_tried
-    with _native_lock:
-        if _native_tried:
-            return _native_lib
-        _native_tried = True
-        here = os.path.dirname(os.path.abspath(__file__))
-        src = os.path.join(here, "recordio_cpp", "recordio.cc")
-        so = os.path.join(here, "_native", "libedlrio.so")
-        try:
-            if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
-                os.makedirs(os.path.dirname(so), exist_ok=True)
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", so],
-                    check=True,
-                    capture_output=True,
-                )
-            lib = ctypes.CDLL(so)
-            lib.edlrio_count.restype = ctypes.c_int64
-            lib.edlrio_count.argtypes = [ctypes.c_char_p]
-            lib.edlrio_index.restype = ctypes.c_int64
-            lib.edlrio_index.argtypes = [
-                ctypes.c_char_p,
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_int64,
-            ]
-            lib.edlrio_verify.restype = ctypes.c_int64
-            lib.edlrio_verify.argtypes = [ctypes.c_char_p]
-            _native_lib = lib
-        except Exception as e:  # pragma: no cover - toolchain missing
-            logger.warning("native recordio unavailable (%s); using Python path", e)
-            _native_lib = None
-        return _native_lib
+    from elasticdl_tpu.common.native_util import compile_and_load
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    return compile_and_load(
+        os.path.join(here, "recordio_cpp", "recordio.cc"),
+        os.path.join(here, "_native", "libedlrio.so"),
+        _configure,
+        what="native recordio",
+    )
 
 
 class RecordIOWriter:

@@ -1,8 +1,8 @@
-"""PS-resident sparse embedding store.
+"""PS-resident sparse embedding store (native C++ core).
 
 Replaces the reference's external 6-node Redis Cluster
 (elasticdl/python/master/embedding_service.py:82-357) with an in-master
-sharded hash store. The API surface is preserved:
+sharded KV store. The API surface is preserved:
 
 - `lookup(layer, ids)` -> (values, unknown_indices)  — mirrors
   `EmbeddingService.lookup_embedding` (:270-313);
@@ -11,27 +11,218 @@ sharded hash store. The API surface is preserved:
   lazy, race-free initialization of unseen ids by concurrent workers
   (doc/distributed_embedding_layer_design.md:278-307).
 
+Where the reference's native engine is redis-server (C) reached over
+sockets with per-key pipelining, ours is an in-process C++ library
+(`embedding_cpp/embedding_store.cc`, compiled lazily like the RecordIO
+indexer): per-layer row arenas with an int64->row hash index and
+readers-writer locking, batch lookup/update as ONE C call over
+contiguous numpy buffers. ctypes releases the GIL during the call, so
+concurrent worker RPC threads do parallel batch lookups. A pure-Python
+dict fallback (`PyEmbeddingStore`) keeps every feature working when no
+C++ toolchain is present (set EDL_TPU_NO_NATIVE_KV=1 to force it).
+
 Rows are keyed `(layer, id)` exactly like the reference's `layer-id`
 string keys (layers/embedding.py:85-87). Optimizer slot rows live in
 the same store under slot-qualified layer names (`layer/slot`),
 mirroring `layer-slot-id` keys (optimizer_wrapper.py:231-290).
-
-Sharded locking: ids hash onto N independent shards so concurrent
-worker lookups don't serialize — the moral equivalent of the Redis
-cluster's 6-way slot sharding.
 """
 
 from __future__ import annotations
 
+import ctypes
+import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
 _NUM_SHARDS = 8
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+
+def _configure(lib: ctypes.CDLL):
+    lib.edlkv_new.restype = ctypes.c_void_p
+    lib.edlkv_free.argtypes = [ctypes.c_void_p]
+    lib.edlkv_dim.restype = ctypes.c_int64
+    lib.edlkv_dim.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.edlkv_lookup.restype = ctypes.c_int64
+    lib.edlkv_lookup.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _I64P, ctypes.c_int64,
+        _F32P, ctypes.c_int64, _I64P,
+    ]
+    lib.edlkv_update.restype = ctypes.c_int64
+    lib.edlkv_update.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _I64P, ctypes.c_int64,
+        _F32P, ctypes.c_int64, ctypes.c_int,
+    ]
+    lib.edlkv_rows.restype = ctypes.c_int64
+    lib.edlkv_rows.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.edlkv_total_rows.restype = ctypes.c_int64
+    lib.edlkv_total_rows.argtypes = [ctypes.c_void_p]
+    lib.edlkv_num_layers.restype = ctypes.c_int64
+    lib.edlkv_num_layers.argtypes = [ctypes.c_void_p]
+    lib.edlkv_layer_name.restype = ctypes.c_int64
+    lib.edlkv_layer_name.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.edlkv_export.restype = ctypes.c_int64
+    lib.edlkv_export.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, _I64P, _F32P,
+        ctypes.c_int64, ctypes.c_int64,
+    ]
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    from elasticdl_tpu.common.native_util import compile_and_load
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    return compile_and_load(
+        os.path.join(here, "embedding_cpp", "embedding_store.cc"),
+        os.path.join(os.path.dirname(here), "data", "_native", "libedlkv.so"),
+        _configure,
+        what="native embedding store",
+    )
 
 
 class EmbeddingStore:
+    """Factory base: `EmbeddingStore()` returns the native-backed store
+    when the C++ library is available, else the Python fallback. Both
+    are subclasses, so isinstance checks and type hints keep working."""
+
+    def __new__(cls, *args, **kwargs):
+        if cls is EmbeddingStore:
+            native = (
+                os.environ.get("EDL_TPU_NO_NATIVE_KV") != "1"
+                and _load_native() is not None
+            )
+            impl = NativeEmbeddingStore if native else PyEmbeddingStore
+            return super().__new__(impl)
+        return super().__new__(cls)
+
+    # API (implemented by subclasses):
+    #   lookup(layer, ids) -> (values [n, dim], unknown_index [k])
+    #   update(layer, ids, values, set_if_not_exist=False)
+    #   snapshot() -> {layer: {id: row}} / restore(snap)
+    #   __len__
+
+
+class NativeEmbeddingStore(EmbeddingStore):
+    def __init__(self):
+        self._lib = _load_native()
+        assert self._lib is not None
+        self._h = ctypes.c_void_p(self._lib.edlkv_new())
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.edlkv_free(h)
+
+    @staticmethod
+    def _ids_buf(ids) -> np.ndarray:
+        return np.ascontiguousarray(np.asarray(ids, dtype=np.int64).reshape(-1))
+
+    def lookup(self, layer: str, ids) -> Tuple[np.ndarray, np.ndarray]:
+        """Batch fetch; returns (values [n, dim], unknown_index [k]).
+        Unknown rows are zero-filled; their positions come back so the
+        caller can lazily initialize them (SETNX), exactly like the
+        reference's lookup_embedding (embedding_service.py:270-313)."""
+        ids_a = self._ids_buf(ids)
+        n = ids_a.shape[0]
+        key = layer.encode()
+        dim = self._lib.edlkv_dim(self._h, key)
+        if dim == 0:  # layer never written: everything is unknown
+            return (
+                np.zeros((n, 0), dtype=np.float32),
+                np.arange(n, dtype=np.int64),
+            )
+        out = np.empty((n, dim), dtype=np.float32)
+        unknown = np.empty(n, dtype=np.int64)
+        misses = self._lib.edlkv_lookup(
+            self._h, key,
+            ids_a.ctypes.data_as(_I64P), n,
+            out.ctypes.data_as(_F32P), dim,
+            unknown.ctypes.data_as(_I64P),
+        )
+        if misses < 0:  # pragma: no cover - dim raced; cannot happen
+            raise ValueError(f"embedding dim mismatch for layer {layer}")
+        return out, unknown[:misses].copy()
+
+    def update(self, layer: str, ids, values, set_if_not_exist: bool = False):
+        """Batch write; with `set_if_not_exist` only absent keys are
+        written (SETNX, reference embedding_service.py:315-357)."""
+        ids_a = self._ids_buf(ids)
+        vals = np.ascontiguousarray(np.asarray(values, dtype=np.float32))
+        vals = vals.reshape(ids_a.shape[0], -1)
+        if ids_a.shape[0] == 0:
+            return
+        written = self._lib.edlkv_update(
+            self._h, layer.encode(),
+            ids_a.ctypes.data_as(_I64P), ids_a.shape[0],
+            vals.ctypes.data_as(_F32P), vals.shape[1],
+            1 if set_if_not_exist else 0,
+        )
+        if written < 0:
+            raise ValueError(
+                f"embedding dim mismatch for layer {layer}: "
+                f"table dim {self._lib.edlkv_dim(self._h, layer.encode())}, "
+                f"got {vals.shape[1]}"
+            )
+
+    # -- introspection / checkpointing ----------------------------------
+
+    def _layers(self) -> List[str]:
+        out = []
+        buf = ctypes.create_string_buffer(4096)
+        for i in range(self._lib.edlkv_num_layers(self._h)):
+            if self._lib.edlkv_layer_name(self._h, i, buf, len(buf)) >= 0:
+                out.append(buf.value.decode())
+        return out
+
+    def snapshot(self) -> Dict[str, Dict[int, np.ndarray]]:
+        """Full table dump {layer: {id: row}} — used by checkpointing.
+        (The reference *cannot* checkpoint its Redis tables — an
+        acknowledged gap, doc/distributed_embedding_layer_design.md:425-428;
+        we close it.)"""
+        out: Dict[str, Dict[int, np.ndarray]] = {}
+        for layer in self._layers():
+            key = layer.encode()
+            dim = self._lib.edlkv_dim(self._h, key)
+            rows = self._lib.edlkv_rows(self._h, key)
+            ids = np.empty(rows, dtype=np.int64)
+            vals = np.empty((rows, dim), dtype=np.float32)
+            # capacity bounds the C-side writes: a concurrent update
+            # may grow the table between edlkv_rows and the export
+            n = self._lib.edlkv_export(
+                self._h, key,
+                ids.ctypes.data_as(_I64P),
+                vals.ctypes.data_as(_F32P), dim, rows,
+            )
+            out[layer] = {
+                int(ids[j]): vals[j].copy() for j in range(max(n, 0))
+            }
+        return out
+
+    def restore(self, snap: Dict[str, Dict[int, np.ndarray]]):
+        for layer, rows in snap.items():
+            if not rows:
+                continue
+            ids = np.fromiter(rows.keys(), dtype=np.int64, count=len(rows))
+            vals = np.stack([np.asarray(r, np.float32) for r in rows.values()])
+            self.update(layer, ids, vals)
+
+    def __len__(self):
+        return self._lib.edlkv_total_rows(self._h)
+
+
+class PyEmbeddingStore(EmbeddingStore):
+    """Pure-Python fallback: sharded dicts with striped locks."""
+
     def __init__(self):
         self._shards: List[Dict[Tuple[str, int], np.ndarray]] = [
             {} for _ in range(_NUM_SHARDS)
@@ -45,12 +236,7 @@ class EmbeddingStore:
     def lookup(
         self, layer: str, ids: np.ndarray
     ) -> Tuple[np.ndarray, np.ndarray]:
-        """Batch fetch; returns (values [n, dim], unknown_index [k]).
-
-        Unknown rows are zero-filled in `values`; their positions are
-        listed in `unknown_index` so the caller can initialize them
-        (reference: embedding_service.py:270-313 returns the same pair).
-        """
+        """Batch fetch; returns (values [n, dim], unknown_index [k])."""
         rows: List[Optional[np.ndarray]] = []
         unknown = []
         for pos, raw_id in enumerate(np.asarray(ids).tolist()):
@@ -79,8 +265,6 @@ class EmbeddingStore:
         values: np.ndarray,
         set_if_not_exist: bool = False,
     ):
-        """Batch write; with `set_if_not_exist` only absent keys are
-        written (SETNX semantics, reference: embedding_service.py:315-357)."""
         values = np.asarray(values, dtype=np.float32)
         for raw_id, row in zip(np.asarray(ids).tolist(), values):
             key = (layer, int(raw_id))
@@ -90,13 +274,9 @@ class EmbeddingStore:
                     continue
                 self._shards[s][key] = np.array(row, dtype=np.float32)
 
-    # -- introspection / checkpointing --------------------------------------
+    # -- introspection / checkpointing ----------------------------------
 
     def snapshot(self) -> Dict[str, Dict[int, np.ndarray]]:
-        """Full table dump {layer: {id: row}} — used by checkpointing.
-        (The reference *cannot* checkpoint its Redis tables — an
-        acknowledged gap, doc/distributed_embedding_layer_design.md:425-428;
-        we close it.)"""
         out: Dict[str, Dict[int, np.ndarray]] = {}
         for s, lock in zip(self._shards, self._locks):
             with lock:

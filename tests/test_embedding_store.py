@@ -1,0 +1,130 @@
+"""Parity tests: the native C++ embedding store and the Python
+fallback must be observably identical (lookup misses, SETNX races,
+overwrite semantics, snapshot/restore round-trip). Reference behavior:
+elasticdl/python/master/embedding_service.py:270-357."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.master.embedding_store import (
+    EmbeddingStore,
+    NativeEmbeddingStore,
+    PyEmbeddingStore,
+    _load_native,
+)
+
+BACKENDS = [PyEmbeddingStore]
+if _load_native() is not None:
+    BACKENDS.append(NativeEmbeddingStore)
+
+
+def test_default_prefers_native_when_available():
+    store = EmbeddingStore()
+    if _load_native() is not None:
+        assert isinstance(store, NativeEmbeddingStore)
+    else:
+        assert isinstance(store, PyEmbeddingStore)
+    assert isinstance(store, EmbeddingStore)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_lookup_update_roundtrip(backend):
+    store = backend()
+    # empty store: all unknown, zero-dim values
+    vals, unknown = store.lookup("emb", np.array([3, 7]))
+    assert vals.shape == (2, 0)
+    np.testing.assert_array_equal(unknown, [0, 1])
+
+    rows = np.arange(8, dtype=np.float32).reshape(2, 4)
+    store.update("emb", np.array([3, 7]), rows)
+    vals, unknown = store.lookup("emb", np.array([7, 5, 3]))
+    assert unknown.tolist() == [1]  # id 5 missing
+    np.testing.assert_array_equal(vals[0], rows[1])
+    np.testing.assert_array_equal(vals[2], rows[0])
+    np.testing.assert_array_equal(vals[1], np.zeros(4))
+    assert len(store) == 2
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_setnx_keeps_existing_rows(backend):
+    store = backend()
+    store.update("emb", [1], np.full((1, 3), 5.0))
+    store.update(
+        "emb", [1, 2], np.zeros((2, 3), np.float32), set_if_not_exist=True
+    )
+    vals, unknown = store.lookup("emb", [1, 2])
+    assert unknown.size == 0
+    np.testing.assert_array_equal(vals[0], np.full(3, 5.0))  # winner kept
+    np.testing.assert_array_equal(vals[1], np.zeros(3))
+    # plain update overwrites
+    store.update("emb", [1], np.full((1, 3), 9.0))
+    vals, _ = store.lookup("emb", [1])
+    np.testing.assert_array_equal(vals[0], np.full(3, 9.0))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_layers_are_independent(backend):
+    store = backend()
+    store.update("a", [0], np.ones((1, 2), np.float32))
+    store.update("a/momentum", [0], np.full((1, 2), 7.0))
+    vals, _ = store.lookup("a", [0])
+    np.testing.assert_array_equal(vals[0], np.ones(2))
+    vals, _ = store.lookup("a/momentum", [0])
+    np.testing.assert_array_equal(vals[0], np.full(2, 7.0))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_snapshot_restore_roundtrip_across_backends(backend):
+    store = backend()
+    store.update("e1", [1, 2], np.arange(6, dtype=np.float32).reshape(2, 3))
+    store.update("e2", [9], np.full((1, 2), 4.0))
+    snap = store.snapshot()
+    assert set(snap) == {"e1", "e2"}
+    # restore into the OTHER backend: snapshots are portable
+    for other in BACKENDS:
+        dst = other()
+        dst.restore(snap)
+        vals, unknown = dst.lookup("e1", [2, 1])
+        assert unknown.size == 0
+        np.testing.assert_array_equal(vals[0], [3, 4, 5])
+        np.testing.assert_array_equal(vals[1], [0, 1, 2])
+        assert len(dst) == 3
+
+
+@pytest.mark.skipif(_load_native() is None, reason="no C++ toolchain")
+def test_native_dim_mismatch_raises():
+    store = NativeEmbeddingStore()
+    store.update("e", [0], np.zeros((1, 4), np.float32))
+    with pytest.raises(ValueError):
+        store.update("e", [1], np.zeros((1, 8), np.float32))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_concurrent_setnx_single_winner(backend):
+    """N threads race SETNX on the same ids with distinct fill values:
+    afterwards every row must equal exactly one thread's fill (no torn
+    rows) — the lazy-init race the SETNX semantics exist for."""
+    store = backend()
+    ids = np.arange(64)
+    fills = [float(t + 1) for t in range(8)]
+    barrier = threading.Barrier(8)
+
+    def racer(fill):
+        barrier.wait()
+        store.update(
+            "emb", ids, np.full((64, 4), fill, np.float32),
+            set_if_not_exist=True,
+        )
+
+    threads = [threading.Thread(target=racer, args=(f,)) for f in fills]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    vals, unknown = store.lookup("emb", ids)
+    assert unknown.size == 0
+    for row in vals:
+        assert row[0] in fills
+        np.testing.assert_array_equal(row, np.full(4, row[0]))
