@@ -1,0 +1,1 @@
+"""TPU kernels (Pallas) for the framework's hot ops."""

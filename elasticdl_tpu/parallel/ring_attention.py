@@ -36,10 +36,18 @@ def ring_attention(
 ) -> jnp.ndarray:
     """q, k, v: [B, Lc, H, D] local sequence chunks -> [B, Lc, H, D].
 
-    With axis size 1 this degenerates to plain (flash-accumulated)
-    attention, so the same code path runs on a single device.
+    With axis size 1 this degenerates to plain attention and delegates
+    to `ops.flash_attention.attention`: XLA's fused attention by
+    default, the Pallas O(L*D)-HBM kernel when EDL_TPU_FLASH=1 on TPU
+    (opt-in — see that module's dispatcher docstring for the measured
+    platform tradeoff). The ring path keeps the lax online-softmax
+    (its K/V blocks already never materialize the full score matrix).
     """
     sp = lax.axis_size(axis_name)
+    if sp == 1:
+        from elasticdl_tpu.ops.flash_attention import attention
+
+        return attention(q, k, v, causal=causal)
     idx = lax.axis_index(axis_name)
     b, lc, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=q.dtype))
