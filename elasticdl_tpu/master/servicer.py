@@ -371,7 +371,7 @@ class MasterServicer:
             resp = {"version": self._version}
             # base fell behind (concurrent syncs): return the merged model
             if base_version + steps != self._version or req.get("want_model"):
-                resp["params_flat"] = codec.ravel_np(self._params)
+                resp["params_flat"] = self._flat_model(req.get("model_dtype"))
                 resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
         self._on_version_bump(applied_version, ckpt_snapshot, prev_version)
         self._report_train_loss(applied_version, req.get("loss"))

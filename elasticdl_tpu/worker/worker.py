@@ -21,6 +21,7 @@ Re-design of the reference worker
 from __future__ import annotations
 
 import inspect
+import os
 import threading
 import time
 from collections import deque
@@ -750,6 +751,10 @@ class Worker:
                 "base_version": base_version,
                 "aux_state": aux_h,
             }
+            if self._transport_dtype == "bfloat16":
+                # merged-model piggyback in bf16: halves the response
+                # bytes on every multi-worker window sync
+                req["model_dtype"] = "bfloat16"
             if step_loss_h is not None:
                 req["loss"] = float(step_loss_h)  # master's metrics sink
             resp = self._master.call("ReportLocalUpdate", req)
@@ -1168,6 +1173,29 @@ class Worker:
             self._local_window_fn = self._build_local_window_fn()
         tx = self._spec.optimizer()
         opt_state = tx.init(self._flat)
+        self.window_flops = None
+        if os.environ.get("EDL_BENCH_MFU") == "1":
+            # XLA's own FLOP count for the compiled window — benches
+            # report MFU from it (SURVEY §6: MFU is part of the perf
+            # contract). Opt-in: .lower().compile() builds a SECOND
+            # executable (the AOT stage does not seed the jit call
+            # cache), so an elastic relaunch must not pay it — only
+            # bench.py sets the flag. Best-effort: cost_analysis is
+            # not on every backend.
+            try:
+                cost = (
+                    self._local_window_fn.lower(
+                        jnp.copy(self._flat), opt_state, self._aux,
+                        features, labels,
+                    )
+                    .compile()
+                    .cost_analysis()
+                )
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0]
+                self.window_flops = float(cost.get("flops", 0.0)) or None
+            except Exception:
+                self.window_flops = None
         out = self._local_window_fn(
             jnp.copy(self._flat), opt_state, self._aux, features, labels
         )
