@@ -79,6 +79,8 @@ class TensorBoardService:
 
     def __init__(self, logdir: str, backend: str = "auto"):
         self.logdir = logdir
+        # EDL_TPU_TB_BACKEND overrides: "torch" (tfevents), "jsonl"
+        backend = os.environ.get("EDL_TPU_TB_BACKEND", backend)
         self._writer = _make_writer(logdir, backend)
         self._tb_proc: Optional[subprocess.Popen] = None
         logger.info(

@@ -36,3 +36,19 @@ def optimizer():
 
 def eval_metrics_fn(predictions, labels):
     return {"mse": jnp.mean((predictions - labels) ** 2)}
+
+
+class PredictionOutputsProcessor:
+    """Sinks predictions to EDL_TEST_PRED_OUT-<worker_id>.npy — lets
+    process-mode e2e tests observe the prediction path (reference ABC:
+    worker/prediction_outputs_processor.py:4-22)."""
+
+    def process(self, predictions, worker_id):
+        base = __import__("os").environ.get("EDL_TEST_PRED_OUT")
+        if base:
+            path = f"{base}-{worker_id}.npy"
+            existing = (
+                np.load(path) if __import__("os").path.exists(path) else
+                np.zeros((0, predictions.shape[-1]), predictions.dtype)
+            )
+            np.save(path, np.concatenate([existing, predictions]))

@@ -1,0 +1,113 @@
+"""Stress the chained async delta-sync pipeline with injected RPC
+jitter (SURVEY §5.2's race-hardening arm, applied to the framework's
+riskiest concurrency: the worker's pipelined sync chain).
+
+Random latency on every master call forces the interleavings the
+plain e2e tests rarely hit — deltas landing while the next windows
+compute, absorbs racing spawns, deferred reports racing both. Two
+invariants are asserted:
+
+1. **Single-worker math invariance**: with one worker the pipeline is
+   a pure latency optimization — the PS trajectory must be exactly
+   sequential local SGD (same final version and parameters as the
+   blocking path, up to float addition order inside a window, which is
+   identical here).
+2. **Exactly-once reporting on a clean run**: every task reports done
+   exactly once (the dispatcher finishes with nothing left in doing,
+   no requeues, no duplicate reports).
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import optax
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.testing import InProcessMaster, write_linear_records
+from elasticdl_tpu.worker.worker import Worker
+
+from tests.fixtures import linear_module
+
+
+class JitteryMaster(InProcessMaster):
+    """InProcessMaster with random per-call latency and a call/report
+    audit trail."""
+
+    def __init__(self, servicer, max_delay=0.02, seed=0):
+        super().__init__(servicer)
+        self._rng = random.Random(seed)
+        self._max_delay = max_delay
+        self._lock = threading.Lock()
+        self.report_calls = []  # (task_id, err_message)
+
+    def call(self, method, req):
+        time.sleep(self._rng.random() * self._max_delay)
+        resp = super().call(method, req)
+        if method == "ReportTaskResult":
+            with self._lock:
+                self.report_calls.append(
+                    (req["task_id"], req.get("err_message", ""))
+                )
+        time.sleep(self._rng.random() * self._max_delay)
+        return resp
+
+
+def _run(tmp_path, *, jitter, seed=0, n_records=96, records_per_task=12):
+    path = str(tmp_path / f"train-{seed}-{jitter}.rio")
+    write_linear_records(path, n_records, noise=0.05)
+    # the dispatcher's per-epoch shuffle draws from the global stream;
+    # pin it so every run sees the same task order and the only
+    # variable is the injected RPC jitter
+    random.seed(42)
+    dispatcher = TaskDispatcher(
+        {path: n_records}, {}, {}, records_per_task, 2
+    )
+    servicer = MasterServicer(
+        grads_to_wait=1,
+        optimizer=PSOptimizer(linear_module.optimizer()),
+        task_dispatcher=dispatcher,
+    )
+    master = JitteryMaster(
+        servicer, max_delay=0.02 if jitter else 0.0, seed=seed
+    )
+    worker = Worker(
+        0,
+        master,
+        spec_from_module(linear_module, optimizer=lambda: optax.sgd(0.1)),
+        minibatch_size=6,
+        local_updates=4,  # tasks of 12 = one whole + one ragged window
+    )
+    assert worker.run()
+    assert dispatcher.finished()
+    params, _aux, version = servicer.get_params_copy()
+    return params, version, master.report_calls, dispatcher
+
+
+def test_jittered_pipeline_matches_jitter_free_run(tmp_path):
+    base_params, base_version, _, _ = _run(tmp_path, jitter=False)
+    for seed in (1, 2, 3):
+        params, version, reports, dispatcher = _run(
+            tmp_path, jitter=True, seed=seed
+        )
+        assert version == base_version
+        import jax
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6,
+                err_msg=f"seed {seed}: pipelined trajectory diverged",
+            ),
+            params,
+            base_params,
+        )
+        # exactly-once reporting: 16 tasks (96/12 * 2 epochs), each
+        # reported done once, none as failure
+        assert len(reports) == 16, reports
+        assert len({t for t, _ in reports}) == 16
+        assert all(err == "" for _, err in reports)
+        assert not dispatcher.has_failed_tasks()
