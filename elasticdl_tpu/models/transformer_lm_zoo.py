@@ -27,6 +27,7 @@ from elasticdl_tpu.models.record_codec import decode_token_records
 from elasticdl_tpu.models.transformer_lm import (
     TransformerConfig,
     init_params,
+    plain_forward,
     reference_forward,
 )
 
@@ -44,7 +45,11 @@ class TransformerLM:
         return {"params": params}
 
     def apply(self, variables, tokens):
-        return reference_forward(self.cfg, variables["params"], tokens)
+        # dense: the vectorized scan-over-layers fast path; MoE falls
+        # back to the (test-oriented) reference loop
+        if self.cfg.n_experts:
+            return reference_forward(self.cfg, variables["params"], tokens)
+        return plain_forward(self.cfg, variables["params"], tokens)
 
 
 def custom_model(**model_params):
