@@ -123,7 +123,13 @@ class Worker:
         self._pending_steps = 0
         self._sync_thread = None  # tail of the chained async delta pushes
         self._sync_inflight: "deque" = deque()  # running sync threads
-        self._max_inflight_syncs = 2  # pipeline depth (windows in flight)
+        # pipeline depth (windows in flight): how many delta syncs may
+        # ride the device link while the device trains ahead. Deeper =
+        # more link overlap on high-latency links, but more staleness
+        # and more un-reported work exposed to preemption (each
+        # in-flight window's tasks stay requeue-able until its sync
+        # lands)
+        self._max_inflight_syncs = int(os.environ.get("EDL_SYNC_DEPTH", 2))
         self._sync_seq = 0  # spawn counter: tags piggyback results
         self._synced_seq = 0  # highest seq whose delta landed on the PS
         self._sync_epoch = 0  # bumped on reset: invalidates spawned syncs
