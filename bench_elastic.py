@@ -162,10 +162,19 @@ def run_job(data_dir, n_records, *, churn: bool, epochs: int, cache_dir: str):
 
 
 def main():
-    # defaults sized for a single-core CI host (the 4 worker processes
-    # + master share whatever cores exist; see the protocol note)
-    n_records = int(os.environ.get("EDL_ELASTIC_BENCH_RECORDS", 4096))
-    epochs = int(os.environ.get("EDL_ELASTIC_BENCH_EPOCHS", 2))
+    # auto-scale to the host: on a single-core machine the worker
+    # processes + master all share one core and the full-size run takes
+    # over an hour — half the records and one epoch still cover 8 tasks
+    # around the kill point (measured ~20 min there)
+    small_host = (os.cpu_count() or 1) < 4
+    n_records = int(
+        os.environ.get(
+            "EDL_ELASTIC_BENCH_RECORDS", 2048 if small_host else 4096
+        )
+    )
+    epochs = int(
+        os.environ.get("EDL_ELASTIC_BENCH_EPOCHS", 1 if small_host else 2)
+    )
     tmp = tempfile.mkdtemp(prefix="edl_elastic_bench_")
     _write_data(tmp, n_records)
     print(

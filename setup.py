@@ -19,6 +19,7 @@ setup(
     packages=find_packages(include=["elasticdl_tpu", "elasticdl_tpu.*"]),
     package_data={
         "elasticdl_tpu.data": ["recordio_cpp/*.cc"],
+        "elasticdl_tpu.master": ["embedding_cpp/*.cc"],
     },
     python_requires=">=3.9",
     install_requires=[
