@@ -140,3 +140,32 @@ print(json.dumps({"ips": ips, "losses": worker.task_losses}))
     result = json.loads(out.stdout.strip().splitlines()[-1])
     assert result["ips"] > 0
     assert result["losses"], "no tasks trained"
+
+
+@pytest.mark.skipif(not TPU, reason="EDL_TPU_TESTS=1 needs the real chip")
+def test_tpu_flash_attention_compiled():
+    """The Pallas kernel compiled on the real chip must match the
+    reference math (the CPU suite covers interpret mode only)."""
+    code = """
+import json, sys
+sys.path.insert(0, %r)
+import jax, jax.numpy as jnp, numpy as np
+from elasticdl_tpu.ops.flash_attention import flash_attention, reference_attention, BLOCK
+rng = np.random.default_rng(0)
+mk = lambda: jnp.asarray(rng.standard_normal((2, 2 * BLOCK, 4, 64)), dtype=jnp.bfloat16)
+q, k, v = mk(), mk(), mk()
+out = jax.jit(lambda q, k, v: flash_attention(q, k, v))(q, k, v)
+ref = reference_attention(
+    q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+print(json.dumps({"err": err}))
+""" % (REPO,)
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    err = json.loads(out.stdout.strip().splitlines()[-1])["err"]
+    assert err < 3e-2, err
