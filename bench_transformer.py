@@ -32,6 +32,9 @@ def main():
     import numpy as np
     import optax
 
+    # Not a no-op: this image's sitecustomize force-registers the axon
+    # TPU platform OVER the env var, so an explicit cpu request needs
+    # the config update too (same handling as bench.py)
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         jax.config.update("jax_platforms", "cpu")
     on_tpu = jax.default_backend() == "tpu"
@@ -73,8 +76,9 @@ def main():
     # as the worker's local-update windows): on tunneled hosts a
     # per-step dispatch costs a host round-trip (~hundreds of ms) that
     # would swamp a ~30ms step — scanning measures the chip, not the
-    # launch path
-    K = 10 if on_tpu else 1
+    # launch path. Clamped so a small EDL_BENCH_TRANSFORMER_STEPS
+    # still times at least one launch.
+    K = min(10 if on_tpu else 1, steps)
 
     @jax.jit
     def multi(params, opt_state, tokens):

@@ -221,14 +221,21 @@ def _stage(cfg, stage_params, x, positions):
     return h, aux
 
 
+def token_cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray):
+    """Mean next-token CE in f32 — THE loss definition, shared by the
+    sharded path, the plain fast path, the dense reference, and the
+    model-zoo spec (one place to fix numerics/masking for all four)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
 def _local_loss(cfg: TransformerConfig, params, inputs, targets):
     """Global mean next-token CE + aux loss, formed inside shard_map."""
     params = jax.tree_util.tree_map(lambda a: a.astype(cfg.dtype), params)
     logits, aux = _local_forward(cfg, params, inputs)
-    logits = logits.astype(jnp.float32)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    ce = jnp.mean(logz - gold)
+    ce = token_cross_entropy(logits, targets)
     loss = lax.pmean(ce, ("dp", "sp"))
     if cfg.n_experts:
         loss = loss + cfg.aux_weight * lax.pmean(
@@ -315,13 +322,7 @@ def build_loss_fn(cfg: TransformerConfig, mesh: Mesh):
 
         def plain_loss(params, tokens):
             logits = plain_forward(cfg, params, tokens[:, :-1])
-            targets = tokens[:, 1:]
-            logits = logits.astype(jnp.float32)
-            logz = jax.scipy.special.logsumexp(logits, axis=-1)
-            gold = jnp.take_along_axis(
-                logits, targets[..., None], axis=-1
-            )[..., 0]
-            return jnp.mean(logz - gold)
+            return token_cross_entropy(logits, tokens[:, 1:])
 
         return plain_loss
 
@@ -444,7 +445,4 @@ def reference_forward(cfg: TransformerConfig, params: Dict, tokens: jnp.ndarray)
 
 def reference_loss(cfg: TransformerConfig, params, tokens):
     logits = reference_forward(cfg, params, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    return token_cross_entropy(logits, tokens[:, 1:])

@@ -29,6 +29,7 @@ from elasticdl_tpu.models.transformer_lm import (
     init_params,
     plain_forward,
     reference_forward,
+    token_cross_entropy,
 )
 
 
@@ -66,9 +67,7 @@ def dataset_fn(records, mode):
 
 
 def loss(outputs, labels):
-    logz = jax.scipy.special.logsumexp(outputs, axis=-1)
-    gold = jnp.take_along_axis(outputs, labels[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    return token_cross_entropy(outputs, labels)
 
 
 def optimizer():
@@ -79,8 +78,6 @@ def optimizer():
 
 
 def eval_metrics_fn(predictions, labels):
-    logz = jax.scipy.special.logsumexp(predictions, axis=-1)
-    gold = jnp.take_along_axis(predictions, labels[..., None], axis=-1)[..., 0]
-    ce = jnp.mean(logz - gold)
+    ce = token_cross_entropy(predictions, labels)
     acc = jnp.mean(jnp.argmax(predictions, axis=-1) == labels)
     return {"cross_entropy": ce, "accuracy": acc, "perplexity": jnp.exp(ce)}

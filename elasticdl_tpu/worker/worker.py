@@ -128,8 +128,15 @@ class Worker:
         # more link overlap on high-latency links, but more staleness
         # and more un-reported work exposed to preemption (each
         # in-flight window's tasks stay requeue-able until its sync
-        # lands)
-        self._max_inflight_syncs = int(os.environ.get("EDL_SYNC_DEPTH", 2))
+        # lands). A malformed value must not kill the worker (the
+        # relaunch budget would burn on a typo): fall back to 2.
+        try:
+            self._max_inflight_syncs = max(
+                0, int(os.environ.get("EDL_SYNC_DEPTH", "2").strip())
+            )
+        except ValueError:
+            logger.warning("ignoring malformed EDL_SYNC_DEPTH; using 2")
+            self._max_inflight_syncs = 2
         self._sync_seq = 0  # spawn counter: tags piggyback results
         self._synced_seq = 0  # highest seq whose delta landed on the PS
         self._sync_epoch = 0  # bumped on reset: invalidates spawned syncs
