@@ -42,6 +42,30 @@ def tensorboard_service_name(job_name: str) -> str:
     return f"tensorboard-{job_name}"
 
 
+def ps_pod_name(job_name: str, shard_id: int) -> str:
+    return f"elasticdl-{job_name}-ps-{shard_id}"
+
+
+def build_ps_pod_manifest(
+    job_name: str,
+    shard_id: int,
+    image: str,
+    command: List[str],
+    **kwargs,
+) -> dict:
+    """A PS shard pod (master/ps_shard_main.py) — worker-shaped but
+    replica type "ps" so the worker watch/relaunch machinery ignores
+    it (shards are job-lifetime services, like the reference's Redis
+    embedding pod — embedding_service.py:231-268)."""
+    pod = build_worker_pod_manifest(
+        job_name, shard_id, image, command, **kwargs
+    )
+    pod["metadata"]["name"] = ps_pod_name(job_name, shard_id)
+    pod["metadata"]["labels"][ELASTICDL_REPLICA_TYPE_KEY] = "ps"
+    pod["spec"]["containers"][0]["name"] = "ps"
+    return pod
+
+
 def build_worker_pod_manifest(
     job_name: str,
     worker_id: int,
@@ -379,6 +403,62 @@ class K8sBackend(PodBackend):
 
     def delete_worker(self, worker_id: int):
         name = worker_pod_name(self._job_name, worker_id)
+        try:
+            self._core.delete_namespaced_pod(name, self._namespace)
+        except Exception:
+            logger.warning("delete pod %s failed:\n%s", name, traceback.format_exc())
+
+    def create_ps_shard(
+        self, shard_id: int, argv: List[str], port: int = 2223
+    ) -> str:
+        """Create a PS shard pod (no wait); returns the pod name.
+        Shards are job-lifetime: no relaunch machinery."""
+        pod = build_ps_pod_manifest(
+            self._job_name,
+            shard_id,
+            self._image,
+            ["python", "-m", "elasticdl_tpu.master.ps_shard_main"]
+            + list(argv)
+            + ["--port", str(port)],
+            namespace=self._namespace,
+            resource_request=self._resource_request,
+            resource_limit=self._resource_limit,
+            volume=self._volume,
+            envs=dict(self._envs),
+            owner_pod=self._owner(),
+        )
+        pod = apply_cluster_spec(pod, self._cluster_spec)
+        self._core.create_namespaced_pod(self._namespace, pod)
+        name = pod["metadata"]["name"]
+        logger.info("Created PS shard pod %s", name)
+        return name
+
+    def wait_ps_shard_ip(
+        self, shard_id: int, port: int = 2223, timeout: float = 300.0
+    ) -> str:
+        """Endpoint of a created PS shard pod, once it has an IP."""
+        import time as _time
+
+        name = ps_pod_name(self._job_name, shard_id)
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            status = self._core.read_namespaced_pod(name, self._namespace).status
+            if status and status.pod_ip:
+                return f"{status.pod_ip}:{port}"
+            _time.sleep(2)
+        raise TimeoutError(f"PS shard pod {name} never got an IP")
+
+    def start_ps_shard(
+        self, shard_id: int, argv: List[str], port: int = 2223
+    ) -> str:
+        """Create + wait in one call (single-shard convenience; the
+        PSShardGroup creates ALL pods first, then polls, so N slow
+        schedules overlap instead of serializing)."""
+        self.create_ps_shard(shard_id, argv, port)
+        return self.wait_ps_shard_ip(shard_id, port)
+
+    def delete_ps_shard(self, shard_id: int):
+        name = ps_pod_name(self._job_name, shard_id)
         try:
             self._core.delete_namespaced_pod(name, self._namespace)
         except Exception:

@@ -97,6 +97,17 @@ def add_master_args(parser: argparse.ArgumentParser):
     parser.add_argument("--use_async", action="store_true")
     parser.add_argument("--lr_staleness_modulation", action="store_true")
     parser.add_argument("--staleness_window", type=non_neg_int, default=0)
+    parser.add_argument(
+        "--num_ps", type=non_neg_int, default=0,
+        help="N>0: shard the dense model across N parameter-server "
+        "endpoints (workers push/pull slices in parallel); 0: the "
+        "master is the single PS",
+    )
+    parser.add_argument(
+        "--ps_mode", default="process", choices=("process", "inproc"),
+        help="sharded-PS hosting: dedicated subprocesses (default) or "
+        "threads inside the master (tests/single-host)",
+    )
     parser.add_argument("--eval_steps", type=non_neg_int, default=0)
     parser.add_argument("--eval_start_delay_secs", type=float, default=0.0)
     parser.add_argument("--eval_throttle_secs", type=float, default=0.0)
@@ -199,6 +210,26 @@ def validate_master_args(args) -> str:
     raise ValueError("one of training/evaluation/prediction data dirs required")
 
 
+def validate_ps_args(args):
+    """Sharded-PS combination checks (see master/ps_shard.py's
+    consistency model): strict per-step sync rejection cannot be
+    atomic across shards, so num_ps > 0 needs a protocol whose
+    application commutes."""
+    if getattr(args, "num_ps", 0) <= 0:
+        return
+    if (
+        not args.use_async
+        and args.local_updates == 0
+        and args.staleness_window == 0
+    ):
+        raise ValueError(
+            "--num_ps > 0 with strict per-step sync SGD is not "
+            "supported (a stale-gradient rejection cannot be atomic "
+            "across shards): use --local_updates N, --use_async, or "
+            "--staleness_window W"
+        )
+
+
 def add_client_args(parser: argparse.ArgumentParser):
     """Client-only flags: image build & master-pod shape (reference:
     common/args.py image/registry params :45-174, api.py:11-227)."""
@@ -281,6 +312,30 @@ def master_forward_args(args) -> List[str]:
         if not action.required and value == action.default:
             continue
         argv += [action.option_strings[0], str(value)]
+    return argv
+
+
+def ps_shard_forward_args(args) -> List[str]:
+    """The model-spec flag subset a master forwards to each PS shard
+    process (the shard resolves `optimizer()` from the model zoo the
+    same way workers do)."""
+    argv = [
+        "--model_zoo", args.model_zoo,
+        "--model_def", args.model_def,
+        "--minibatch_size", str(args.minibatch_size),
+        "--log_level", args.log_level,
+    ]
+    for flag in (
+        "model_params",
+        "dataset_fn",
+        "loss",
+        "optimizer",
+        "eval_metrics_fn",
+        "prediction_outputs_processor",
+    ):
+        value = getattr(args, flag)
+        if value:
+            argv += [f"--{flag}", value]
     return argv
 
 

@@ -59,19 +59,13 @@ def collect_shards(path: str) -> dict:
     return shards
 
 
-def build_master(args, job_type: str):
-    """Dispatcher + servicer + services, shared by main() and tests."""
+def build_master(args, job_type: str, cluster_backend=None):
+    """Dispatcher + servicer + services, shared by main() and tests.
+    `cluster_backend` (a K8sBackend) is required only when a sharded PS
+    must run as dedicated pods (worker_backend=k8s + num_ps>0)."""
     from elasticdl_tpu.api.model_spec import get_model_spec
-    from elasticdl_tpu.master.checkpoint import (
-        CheckpointService,
-        load_model_file,
-    )
     from elasticdl_tpu.master.embedding_store import EmbeddingStore
-    from elasticdl_tpu.master.evaluation_service import EvaluationService
-    from elasticdl_tpu.master.ps_optimizer import PSOptimizer
-    from elasticdl_tpu.master.servicer import MasterServicer
     from elasticdl_tpu.master.sparse_optimizer import SparseOptimizer
-    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 
     spec = get_model_spec(
         model_zoo=args.model_zoo,
@@ -104,6 +98,65 @@ def build_master(args, job_type: str):
     if spec.embedding_specs:
         store = EmbeddingStore()
         sparse_opt = SparseOptimizer(store, **(spec.sparse_optimizer or {}))
+
+    # Sharded PS (master/ps_shard.py): the dense model behind N
+    # endpoints; workers push/pull slices in parallel while the master
+    # keeps the control plane. See ps_shard.py for the consistency
+    # model and validate_ps_args for the protocol constraints.
+    ps_group = None
+    if getattr(args, "num_ps", 0) > 0:
+        from elasticdl_tpu.common.args import (
+            ps_shard_forward_args,
+            validate_ps_args,
+        )
+        from elasticdl_tpu.master.ps_group import PSShardGroup
+
+        validate_ps_args(args)
+        if spec.embedding_specs:
+            raise ValueError(
+                "--num_ps does not support elastic-embedding models: "
+                "sparse tables live in the master-resident store and "
+                "their per-step gradients need the master path"
+            )
+        # k8s jobs need worker-REACHABLE shard endpoints: localhost
+        # subprocesses inside the master pod are invisible to worker
+        # pods, so the shards become dedicated pods addressed by pod IP
+        mode = getattr(args, "ps_mode", "process")
+        if getattr(args, "worker_backend", "") == "k8s":
+            mode = "k8s"
+        ps_group = PSShardGroup(
+            args.num_ps,
+            mode=mode,
+            optimizer_factory=spec.optimizer,
+            shard_argv=ps_shard_forward_args(args),
+            grads_to_wait=args.grads_to_wait,
+            use_async=args.use_async,
+            lr_staleness_modulation=args.lr_staleness_modulation,
+            staleness_window=args.staleness_window,
+            k8s_backend=cluster_backend if mode == "k8s" else None,
+        )
+        ps_group.start()
+
+    try:
+        return _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
+                             training, evaluation, prediction)
+    except Exception:
+        # shard subprocesses/pods must not outlive a failed boot
+        if ps_group is not None:
+            ps_group.stop()
+        raise
+
+
+def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
+                  training, evaluation, prediction):
+    from elasticdl_tpu.master.checkpoint import (
+        CheckpointService,
+        load_model_file,
+    )
+    from elasticdl_tpu.master.evaluation_service import EvaluationService
+    from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 
     # boot-from-checkpoint (reference: servicer.py:80-84) — the only
     # way evaluate/predict jobs get params, and the resume path for
@@ -155,7 +208,12 @@ def build_master(args, job_type: str):
         use_async=args.use_async,
         lr_staleness_modulation=args.lr_staleness_modulation,
         staleness_window=args.staleness_window,
+        ps_group=ps_group,
     )
+    if ps_group is not None and init_params is not None:
+        from elasticdl_tpu.common import codec
+
+        ps_group.ensure_init(codec.ravel_np(init_params), init_version)
     tb_service = None
     if getattr(args, "tensorboard_log_dir", ""):
         from elasticdl_tpu.master.tensorboard_service import TensorBoardService
@@ -222,18 +280,25 @@ def main(argv=None) -> int:
     from elasticdl_tpu.master.worker_manager import WorkerManager
     from elasticdl_tpu.rpc.server import RpcServer
 
+    # the cluster backend exists before build_master: a k8s sharded PS
+    # creates its shard pods through it during the build
+    backend = make_backend(args)
     try:
         spec, dispatcher, servicer, eval_service, ckpt = build_master(
-            args, job_type
+            args, job_type, cluster_backend=backend
         )
     except (ValueError, OSError) as e:
         # bad data dir / unreadable shards / malformed checkpoint are
         # config errors: exit 1 cleanly, like validate_master_args
         logger.error("master boot failed: %s", e)
+        backend.stop()
         return 1
     if job_type in (JobType.EVALUATION_ONLY, JobType.PREDICTION_ONLY):
         if not servicer.model_initialized():
             logger.error("evaluate/predict jobs need an initialized model")
+            if servicer.ps_group is not None:
+                servicer.ps_group.stop()
+            backend.stop()
             return 1
     if job_type == JobType.EVALUATION_ONLY and eval_service is not None:
         from elasticdl_tpu.common.messages import TaskType
@@ -259,7 +324,6 @@ def main(argv=None) -> int:
         # in-cluster: serve the summaries so the TensorBoard k8s
         # Service (created by the client) has a target on :6006
         servicer.tb_service.start_tensorboard_process()
-    backend = make_backend(args)
     manager = WorkerManager(
         backend,
         dispatcher,
@@ -303,6 +367,8 @@ def main(argv=None) -> int:
             eval_service.stop()
         if servicer.tb_service is not None:
             servicer.tb_service.close()
+        if servicer.ps_group is not None:
+            servicer.ps_group.stop()
         backend.stop()
         server.stop()
     return exit_code

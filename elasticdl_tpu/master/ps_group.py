@@ -1,0 +1,250 @@
+"""Master-side lifecycle manager for the sharded PS endpoints.
+
+Two hosting modes:
+
+- ``inproc``: shard servicers live on threads inside the master
+  process behind real RPC servers on localhost — hermetic tests and
+  single-host jobs (still N sockets and N locks, so worker fan-out
+  parallelism is real; the GIL is released during socket IO and the
+  numpy/optax slice math releases it for large arrays).
+- ``process``: each shard is a subprocess of
+  ``python -m elasticdl_tpu.master.ps_shard_main`` — its own
+  interpreter, so PS CPU (optimizer applies, msgpack codec) scales
+  with shards. The shard binds an ephemeral port and publishes it
+  through ``--port_file`` (no bind races).
+
+On Kubernetes the same entrypoint runs in dedicated PS pods (replica
+type "ps", created like worker pods — see cluster/k8s_backend.py);
+this manager handles the local modes, which is what the master uses
+when ``--worker_backend process``.
+
+The group is NOT elastic: shards are job-lifetime services, exactly
+like the reference's Redis embedding pods (reference:
+elasticdl/python/master/embedding_service.py:231-268 — spawned at
+master boot, torn down with the job). Elasticity lives in the worker
+fleet; a dead shard is a job failure (the reference's dead-Redis
+story is the same).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.rpc.ps_client import ShardedPS
+
+logger = get_logger(__name__)
+
+
+class PSShardGroup:
+    """Owns N PS shard endpoints for one job."""
+
+    def __init__(
+        self,
+        num_shards: int,
+        mode: str = "inproc",
+        optimizer_factory=None,  # () -> optax.GradientTransformation
+        shard_argv: Optional[List[str]] = None,  # model-spec flags (process)
+        grads_to_wait: int = 1,
+        use_async: bool = False,
+        lr_staleness_modulation: bool = False,
+        staleness_window: int = 0,
+        boot_timeout: float = 60.0,
+        k8s_backend=None,  # K8sBackend for mode="k8s" (PS pods)
+    ):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        if mode not in ("inproc", "process", "k8s"):
+            raise ValueError(f"unknown ps group mode {mode!r}")
+        if mode in ("process", "k8s") and shard_argv is None:
+            raise ValueError(f"{mode} mode needs the model-spec argv")
+        if mode == "k8s" and k8s_backend is None:
+            raise ValueError("k8s mode needs the cluster backend")
+        self._k8s_backend = k8s_backend
+        self._n = num_shards
+        self._mode = mode
+        self._opt_factory = optimizer_factory
+        self._shard_argv = list(shard_argv or [])
+        self._sync_flags = dict(
+            grads_to_wait=grads_to_wait,
+            use_async=use_async,
+            lr_staleness_modulation=lr_staleness_modulation,
+            staleness_window=staleness_window,
+        )
+        self._boot_timeout = boot_timeout
+        self.endpoints: List[str] = []
+        self._servers = []  # inproc RpcServers
+        self._procs: List[subprocess.Popen] = []
+        self._client: Optional[ShardedPS] = None
+        self._n_params = -1
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> List[str]:
+        if self.endpoints:
+            return self.endpoints
+        if self._mode == "inproc":
+            self._start_inproc()
+        elif self._mode == "k8s":
+            self._start_k8s()
+        else:
+            self._start_process()
+        logger.info(
+            "PS shard group up (%s): %s", self._mode, ", ".join(self.endpoints)
+        )
+        return self.endpoints
+
+    def _shard_cli_flags(self, shard_id: int) -> List[str]:
+        """The shard entrypoint's own flags (shared by process/k8s)."""
+        flags = [
+            "--shard_id", str(shard_id),
+            "--num_shards", str(self._n),
+            "--grads_to_wait", str(self._sync_flags["grads_to_wait"]),
+            "--staleness_window", str(self._sync_flags["staleness_window"]),
+        ] + self._shard_argv
+        if self._sync_flags["use_async"]:
+            flags.append("--use_async")
+        if self._sync_flags["lr_staleness_modulation"]:
+            flags.append("--lr_staleness_modulation")
+        return flags
+
+    def _start_k8s(self):
+        """Dedicated PS pods (replica type "ps"): the shard entrypoint
+        runs in its own pod and workers reach it by pod IP — the
+        worker-reachable analog of the reference's Redis pod. All pods
+        are created FIRST, then polled, so N slow pod schedules overlap
+        instead of serializing N boot waits."""
+        if hasattr(self._k8s_backend, "create_ps_shard"):
+            for i in range(self._n):
+                self._k8s_backend.create_ps_shard(i, self._shard_cli_flags(i))
+            for i in range(self._n):
+                self.endpoints.append(
+                    self._k8s_backend.wait_ps_shard_ip(
+                        i, timeout=self._boot_timeout * 5
+                    )
+                )
+        else:  # minimal backends (tests) expose only the combined call
+            for i in range(self._n):
+                self.endpoints.append(
+                    self._k8s_backend.start_ps_shard(
+                        i, self._shard_cli_flags(i)
+                    )
+                )
+
+    def _start_inproc(self):
+        from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+        from elasticdl_tpu.master.ps_shard import PSShardServicer
+        from elasticdl_tpu.rpc.server import RpcServer
+
+        for i in range(self._n):
+            opt = (
+                PSOptimizer(self._opt_factory())
+                if self._opt_factory is not None
+                else None
+            )
+            servicer = PSShardServicer(
+                i, self._n, optimizer=opt, **self._sync_flags
+            )
+            server = RpcServer(servicer.handlers(), port=0)
+            server.start()
+            self._servers.append(server)
+            self.endpoints.append(f"localhost:{server.port}")
+
+    def _start_process(self):
+        tmp = tempfile.mkdtemp(prefix="edl_ps_")
+        port_files = []
+        for i in range(self._n):
+            port_file = os.path.join(tmp, f"shard-{i}.port")
+            port_files.append(port_file)
+            argv = [
+                sys.executable,
+                "-m",
+                "elasticdl_tpu.master.ps_shard_main",
+                "--port", "0",
+                "--port_file", port_file,
+            ] + self._shard_cli_flags(i)
+            env = dict(os.environ)
+            # PS math is host math: never let a shard grab the TPU
+            # (ps_shard_main also pins the backend itself — the image's
+            # sitecustomize overrides the env var)
+            env["JAX_PLATFORMS"] = "cpu"
+            import elasticdl_tpu
+
+            pkg_root = os.path.dirname(
+                os.path.dirname(elasticdl_tpu.__file__)
+            )
+            env["PYTHONPATH"] = (
+                pkg_root + os.pathsep + env["PYTHONPATH"]
+                if env.get("PYTHONPATH")
+                else pkg_root
+            )
+            self._procs.append(subprocess.Popen(argv, env=env))
+        deadline = time.time() + self._boot_timeout
+        for i, pf in enumerate(port_files):
+            while not os.path.exists(pf):
+                if self._procs[i].poll() is not None:
+                    raise RuntimeError(
+                        f"PS shard {i} exited rc={self._procs[i].returncode} "
+                        "before publishing its port"
+                    )
+                if time.time() > deadline:
+                    raise TimeoutError(f"PS shard {i} did not publish a port")
+                time.sleep(0.05)
+            with open(pf) as f:
+                self.endpoints.append(f"localhost:{int(f.read().strip())}")
+
+    def stop(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        for s in self._servers:
+            s.stop()
+        self._servers = []
+        if self._mode == "k8s" and self.endpoints:
+            for i in range(self._n):
+                self._k8s_backend.delete_ps_shard(i)
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs = []
+        self.endpoints = []
+
+    # -- model plane ---------------------------------------------------------
+
+    def client(self, n_params: Optional[int] = None) -> ShardedPS:
+        if self._client is None:
+            if n_params is None:
+                raise RuntimeError("PS group client needs n_params once")
+            self._n_params = int(n_params)
+            self._client = ShardedPS(self.endpoints, self._n_params)
+            self._client.wait_ready(self._boot_timeout)
+        return self._client
+
+    @property
+    def initialized(self) -> bool:
+        return self._client is not None
+
+    def ensure_init(self, vec: np.ndarray, version: int = 0) -> List[int]:
+        """Idempotent model init (shard-side SETNX)."""
+        vec = np.asarray(vec, dtype=np.float32)
+        return self.client(vec.size).init_model(vec, version)
+
+    def assemble(self, model_dtype: Optional[str] = None):
+        """(shard_versions, full_flat_vec) — the master's view for
+        checkpoints/eval snapshots; slices are pulled concurrently and
+        may straddle a step (relaxed snapshot, see ps_shard.py)."""
+        if self._client is None:
+            raise RuntimeError("PS group not initialized")
+        return self._client.pull(model_dtype=model_dtype)

@@ -1,0 +1,230 @@
+"""Sharded parameter server: the dense model split across N endpoints.
+
+The reference's master is a single PS holding the whole model; its own
+design docs call the resulting full-model-pull / full-gradient-push
+bandwidth the scaling wall (reference:
+elasticdl/doc/worker_optimization_design.md — get_model/report_gradient
+dominate the step; SURVEY §7.3 item 3 names "model-sharded PS" as the
+remedy that must preserve the any-K-reports elasticity semantics).
+
+This module provides that remedy natively for the flat-buffer
+transport: the raveled f32 parameter vector (codec.ravel_np order) is
+split into `num_shards` contiguous slices, each owned by a
+`PSShardServicer` behind its own RPC endpoint. Workers push gradient /
+delta SLICES to all shards in parallel — N sockets, N servicer locks,
+N optimizer applies — so PS bandwidth and PS CPU scale with the shard
+count instead of walling at one endpoint. The control plane (tasks,
+evaluation, checkpoints, the sparse embedding store) stays on the
+master: shards are deliberately dumb slice-holders, like the
+reference's Redis shards were for embeddings (reference:
+elasticdl/python/master/embedding_service.py:82-99 — 6 independent
+stores behind one logical table).
+
+Consistency model per protocol:
+
+- **local-update / SSP windows** (the TPU-idiomatic hot path): deltas
+  are additive and never rejected, so per-shard application commutes —
+  a single worker gets exactly per-step-sync math (as with one PS) and
+  multiple workers get local-SGD merge semantics, per slice. Staleness
+  down-weighting applies per shard with each shard's own version.
+- **async per-step**: each shard applies its gradient slice
+  immediately (optionally staleness-LR-modulated). Elementwise
+  optimizers (sgd/momentum/adam/...) make the slice-wise apply
+  identical to the whole-vector apply.
+- **strict sync per-step** (version-equality rejection) is NOT offered
+  across shards: a gradient accepted by shard A and rejected by shard
+  B would leave a torn update with no atomic retry. Master boot
+  rejects that configuration (use a staleness window, async, or
+  windows — or a single PS).
+
+Shard versions advance independently; they agree on the NUMBER of
+applied steps per worker stream but may interleave concurrent workers
+differently (the standard sharded-PS relaxation — each slice still
+sees every report exactly once).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common import codec
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+
+logger = get_logger(__name__)
+
+
+def slice_boundaries(n_params: int, num_shards: int) -> List[Tuple[int, int]]:
+    """Deterministic near-equal split of [0, n_params) into contiguous
+    shard slices — computed identically by master and workers from
+    (n_params, num_shards) alone, so no boundary table rides the wire."""
+    if num_shards <= 0:
+        raise ValueError(f"num_shards must be > 0, got {num_shards}")
+    edges = np.linspace(0, n_params, num_shards + 1).astype(np.int64)
+    return [(int(edges[i]), int(edges[i + 1])) for i in range(num_shards)]
+
+
+class PSShardServicer:
+    """One shard: a contiguous slice of the flat f32 model vector plus
+    its optimizer state. Mirrors MasterServicer's gradient semantics
+    (servicer.py report_gradient / report_local_update) restricted to a
+    single array; see the module docstring for the consistency model."""
+
+    def __init__(
+        self,
+        shard_id: int,
+        num_shards: int,
+        optimizer: Optional[PSOptimizer] = None,
+        grads_to_wait: int = 1,
+        use_async: bool = False,
+        lr_staleness_modulation: bool = False,
+        staleness_window: int = 0,
+    ):
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self._opt = optimizer
+        self._grads_to_wait = grads_to_wait
+        self._use_async = use_async
+        self._lr_staleness_modulation = lr_staleness_modulation
+        self._staleness_window = staleness_window
+
+        self._lock = threading.Lock()
+        self._vec: Optional[np.ndarray] = None  # f32 [slice_len]
+        self._version = 0
+        self._grad_sum: Optional[np.ndarray] = None
+        self._grad_n = 0
+
+    # -- handler table -------------------------------------------------------
+
+    def handlers(self) -> Dict[str, Any]:
+        return {
+            "PSInit": self.init_slice,
+            "PSPull": self.pull,
+            "PSPushGrad": self.push_grad,
+            "PSPushDelta": self.push_delta,
+        }
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def initialized(self) -> bool:
+        return self._vec is not None
+
+    # -- RPCs ----------------------------------------------------------------
+
+    def init_slice(self, req: dict) -> dict:
+        """SETNX semantics (like the embedding store's set_if_not_exist,
+        reference embedding_service.py:315-357): the first initializer
+        wins; late/racing initializers get the current version back."""
+        with self._lock:
+            if self._vec is None:
+                self._vec = np.asarray(req["vec"], dtype=np.float32).copy()
+                self._version = int(req.get("version", 0))
+                logger.info(
+                    "PS shard %d/%d initialized: %d params at v%d",
+                    self.shard_id,
+                    self.num_shards,
+                    self._vec.size,
+                    self._version,
+                )
+            return {"version": self._version, "size": self._vec.size}
+
+    def pull(self, req: dict) -> dict:
+        with self._lock:
+            if self._vec is None:
+                return {"version": -1, "vec": None}
+            if req.get("only_if_newer") and self._version <= req.get(
+                "version", -1
+            ):
+                return {"version": self._version, "vec": None}
+            return {"version": self._version, "vec": self._wire_vec(req)}
+
+    def push_grad(self, req: dict) -> dict:
+        """Per-step gradient slice. Async mode applies immediately
+        (optionally LR-modulated by 1/staleness); sync mode accumulates
+        `grads_to_wait` reports within the staleness window. Strict
+        equality rejection is refused at configuration time (module
+        docstring) so an accept can never be torn across shards."""
+        grad = np.asarray(req["grad"], dtype=np.float32)
+        report_version = int(req.get("version", -1))
+        with self._lock:
+            if self._vec is None:
+                raise ValueError("gradient pushed before shard init")
+            if grad.shape != self._vec.shape:
+                raise ValueError(
+                    f"grad slice shape {grad.shape} != {self._vec.shape}"
+                )
+            staleness = self._version - report_version
+            if self._use_async:
+                scale = 1.0
+                if self._lr_staleness_modulation and staleness > 1:
+                    scale = 1.0 / float(staleness)
+                self._apply(grad * scale if scale != 1.0 else grad)
+            else:
+                # windowed sync: accumulate K reports; staleness beyond
+                # the window is down-weighted (window/staleness) rather
+                # than rejected — rejection cannot be atomic across
+                # shards (module docstring)
+                if self._staleness_window and staleness > self._staleness_window:
+                    grad = grad * (self._staleness_window / float(staleness))
+                if self._grad_sum is None:
+                    self._grad_sum = grad.copy()
+                else:
+                    self._grad_sum += grad
+                self._grad_n += 1
+                if self._grad_n >= self._grads_to_wait:
+                    self._apply(self._grad_sum / self._grad_n)
+                    self._grad_sum = None
+                    self._grad_n = 0
+            resp = {"accepted": True, "version": self._version}
+            if req.get("return_model") and self._version != report_version:
+                resp["vec"] = self._wire_vec(req)
+            return resp
+
+    def push_delta(self, req: dict) -> dict:
+        """Local-update window delta for this slice — mirrors
+        MasterServicer.report_local_update: add, advance version by
+        `steps`, hand the merged slice back when the pusher's base fell
+        behind (another worker synced in between)."""
+        steps = int(req["steps"])
+        base_version = int(req["base_version"])
+        with self._lock:
+            if self._vec is None:
+                raise ValueError("delta pushed before shard init")
+            delta = np.asarray(req["delta"], dtype=np.float32)
+            if delta.shape != self._vec.shape:
+                raise ValueError(
+                    f"delta slice shape {delta.shape} != {self._vec.shape}"
+                )
+            scale = 1.0
+            if self._staleness_window:
+                staleness = self._version - base_version
+                if staleness > self._staleness_window:
+                    scale = self._staleness_window / float(staleness)
+            self._vec += scale * delta if scale != 1.0 else delta
+            self._version += steps
+            resp = {"version": self._version}
+            if base_version + steps != self._version or req.get("want_model"):
+                resp["vec"] = self._wire_vec(req)
+            return resp
+
+    # -- internals -----------------------------------------------------------
+
+    def _wire_vec(self, req: dict) -> np.ndarray:
+        dtype = req.get("model_dtype")
+        if dtype and dtype != "float32":
+            return self._vec.astype(codec.dtype_from_str(dtype))
+        return self._vec.copy()
+
+    def _apply(self, grad: np.ndarray):
+        """Optimizer step on the slice (caller holds the lock).
+        Elementwise optimizers make the slice-wise apply exact."""
+        if self._opt is not None:
+            self._vec = np.asarray(self._opt.step(self._vec, grad))
+        else:
+            self._vec = self._vec - grad
+        self._version += 1

@@ -67,7 +67,16 @@ class MasterServicer:
         use_async: bool = False,
         lr_staleness_modulation: bool = False,
         staleness_window: int = 0,
+        ps_group=None,
     ):
+        # Sharded PS (master/ps_group.py): the dense model lives behind
+        # N shard endpoints and workers push slices there directly; the
+        # master keeps the TEMPLATE tree (structure/shapes for
+        # assembly), the control plane, and the cadence mirror driven
+        # by ReportWindowMeta. None = classic single-PS-in-master.
+        # Public alias: main/tests tear the group down through the
+        # servicer, like tb_service.
+        self._ps_group = self.ps_group = ps_group
         self._lock = threading.Lock()
         self._grads_to_wait = grads_to_wait
         self._opt = optimizer
@@ -104,6 +113,9 @@ class MasterServicer:
             "ReportTaskResult": self.report_task_result,
             "EmbeddingLookup": self.embedding_lookup,
             "EmbeddingUpdate": self.embedding_update,
+            "GetPSConfig": self.get_ps_config,
+            "ReportWindowMeta": self.report_window_meta,
+            "GetAux": self.get_aux,
         }
 
     # -- model state --------------------------------------------------------
@@ -116,6 +128,26 @@ class MasterServicer:
         return self._params is not None
 
     def get_params_copy(self):
+        if self._ps_group is not None and self._params is not None:
+            # assemble the authoritative values from the shards; the
+            # master's tree is only the template. Slices are pulled
+            # concurrently and may straddle a step (relaxed snapshot —
+            # see ps_shard.py's consistency model); the reported
+            # version is the lowest shard version in the snapshot.
+            # During the lazy-init window (template set, shards not yet
+            # seeded) the template IS the current model — serve it
+            # rather than crashing a caller on an uninitialized group.
+            vec = None
+            if self._ps_group.initialized:
+                versions, vec = self._ps_group.assemble()
+            if vec is not None:
+                with self._lock:
+                    aux = jax.tree_util.tree_map(np.copy, self._aux)
+                return (
+                    codec.unravel_np(vec, self._params),
+                    aux,
+                    min(versions),
+                )
         with self._lock:
             return (
                 jax.tree_util.tree_map(np.copy, self._params),
@@ -166,6 +198,27 @@ class MasterServicer:
         snapshot store."""
         version = req.get("version", 0)
         method = req.get("method", MethodType.MINIMUM)
+        if method == MethodType.MINIMUM and self._ps_group is not None:
+            # sharded mode: workers normally pull slices straight from
+            # the shards — this path serves worker BOOT (the template
+            # tree must ride along once) and tree-form callers, so it
+            # assembles unconditionally
+            with self._lock:
+                template = self._params
+                aux = jax.tree_util.tree_map(np.copy, self._aux)
+            if template is None or not self._ps_group.initialized:
+                return {"version": -1, "params": None, "aux": None}
+            versions, vec = self._ps_group.assemble()
+            if vec is None:  # shards racing their SETNX init
+                return {"version": -1, "params": None, "aux": None}
+            v = min(versions)
+            if req.get("flat"):
+                return {"version": v, "params_flat": vec, "aux": aux}
+            return {
+                "version": v,
+                "params": codec.unravel_np(vec, template),
+                "aux": aux,
+            }
         if method == MethodType.MINIMUM:
             with self._lock:
                 if self._params is None:
@@ -189,9 +242,15 @@ class MasterServicer:
                 }
         # FIXED: serve the exact version — from live PS state when it
         # still matches (standalone eval jobs never train past it),
-        # else from the eval-snapshot store / durable checkpoints
+        # else from the eval-snapshot store / durable checkpoints.
+        # Sharded mode never live-serves: the master tree is only the
+        # template; exact versions come from snapshots.
         with self._lock:
-            if version == self._version and self._params is not None:
+            if (
+                self._ps_group is None
+                and version == self._version
+                and self._params is not None
+            ):
                 return {
                     "version": self._version,
                     "params": jax.tree_util.tree_map(np.copy, self._params),
@@ -208,18 +267,30 @@ class MasterServicer:
 
     def report_variable(self, req: dict) -> dict:
         """Lazy model init from the first worker
-        (reference: servicer.py:299-303)."""
+        (reference: servicer.py:299-303). In sharded mode the master
+        keeps the tree as the assembly template and seeds the shards
+        (their SETNX makes racing initializers harmless)."""
         with self._lock:
-            if self._params is None:
+            first = self._params is None
+            if first:
                 self._params = _to_f32(req["params"])
                 if req.get("aux") is not None:
                     self._aux = req["aux"]
+        if first and self._ps_group is not None:
+            self._ps_group.ensure_init(
+                codec.ravel_np(self._params), self._version
+            )
         return {}
 
     # -- RPC: gradients (the hot path) --------------------------------------
 
     def report_gradient(self, req: dict) -> dict:
         """reference: servicer.py:305-402. Returns {accepted, version}."""
+        if self._ps_group is not None:
+            raise ValueError(
+                "sharded PS: gradients go to the shard endpoints "
+                "(PSPushGrad), not the master"
+            )
         report_version = req.get("version", -1)
         grads = req.get("gradient")
         edl_grads: Dict[str, IndexedRows] = req.get("edl_gradient") or {}
@@ -327,6 +398,11 @@ class MasterServicer:
         per-step sync SGD — the delta is exactly the sum of its local
         updates — while moving the model over the wire once per window
         instead of twice per minibatch."""
+        if self._ps_group is not None:
+            raise ValueError(
+                "sharded PS: deltas go to the shard endpoints "
+                "(PSPushDelta), not the master"
+            )
         steps = int(req["steps"])
         base_version = int(req["base_version"])
         aux_state = req.get("aux_state")
@@ -375,6 +451,69 @@ class MasterServicer:
                 resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
         self._on_version_bump(applied_version, ckpt_snapshot, prev_version)
         self._report_train_loss(applied_version, req.get("loss"))
+        return resp
+
+    def get_ps_config(self, req: dict) -> dict:
+        """Shard-endpoint discovery for (re)joining workers — a
+        relaunched worker must not depend on argv staying current."""
+        if self._ps_group is None:
+            return {"endpoints": [], "n_params": -1}
+        with self._lock:
+            n = (
+                sum(
+                    int(np.asarray(leaf).size)
+                    for leaf in jax.tree_util.tree_leaves(self._params)
+                )
+                if self._params is not None
+                else -1
+            )
+        return {"endpoints": self._ps_group.endpoints, "n_params": n}
+
+    def get_aux(self, req: dict) -> dict:
+        """Non-trainable state for sharded-mode pull refreshes: shards
+        hold only the dense vector, so a worker re-syncing its params
+        from them fetches the matching aux here (single-PS pulls carry
+        aux inline — get_model)."""
+        with self._lock:
+            return {
+                "aux": jax.tree_util.tree_map(np.copy, self._aux),
+                "version": self._version,
+            }
+
+    def report_window_meta(self, req: dict) -> dict:
+        """Sharded-mode control-plane report: after pushing slices to
+        the shards, workers send the tiny metadata here — per-shard
+        versions, window loss, non-trainable aux. This drives the
+        master's version mirror, the checkpoint/eval cadence (which the
+        single-PS path drives from its own version bumps), and the
+        metrics sink. Aux is last-writer-wins, as in _apply."""
+        versions = req.get("versions") or []
+        version = min(int(v) for v in versions) if versions else -1
+        resp = {}
+        with self._lock:
+            prev = self._version
+            advanced = version > prev
+            if advanced:
+                self._version = version
+            if req.get("aux_state") is not None:
+                self._aux = req["aux_state"]
+            if req.get("want_aux"):
+                # the pusher absorbed merged slices (its base fell
+                # behind) and wants the matching non-trainable state —
+                # mirrors the aux piggyback on report_local_update
+                resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
+        if advanced:
+            ckpt_snapshot = None
+            if self._checkpoint_service and self._checkpoint_service.crossed(
+                prev, version
+            ):
+                # assembled AFTER the crossing report: a relaxed
+                # snapshot at >= the crossing version (ps_shard.py)
+                params, aux, v = self.get_params_copy()
+                ckpt_snapshot = (params, aux)
+                version = max(version, v)
+            self._on_version_bump(version, ckpt_snapshot, prev)
+            self._report_train_loss(version, req.get("loss"))
         return resp
 
     def _flat_model(self, model_dtype=None):
@@ -485,5 +624,9 @@ class MasterServicer:
         """reference: servicer.py:255-267."""
         from elasticdl_tpu.master.checkpoint import save_model_file
 
+        if self._ps_group is not None:
+            params, aux, version = self.get_params_copy()
+            save_model_file(output_path, params, version, aux=aux)
+            return
         with self._lock:
             save_model_file(output_path, self._params, self._version, aux=self._aux)

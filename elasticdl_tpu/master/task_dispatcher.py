@@ -35,8 +35,16 @@ class TaskDispatcher:
         num_epochs: int,
         max_task_retries: int = 10,
         eval_model_version: int = -1,
+        shuffle_seed: Optional[int] = None,
     ):
         self._lock = threading.Lock()
+        # per-dispatcher RNG: a seed pins the epoch shuffle order
+        # (deterministic replays / equivalence tests); None keeps the
+        # reference's behavior — the process-global stream, which
+        # `random.seed()` callers can still pin externally
+        self._shuffle_rng = (
+            random.Random(shuffle_seed) if shuffle_seed is not None else random
+        )
         # Unlike the reference (which requeues failed tasks forever,
         # task_dispatcher.py:153-176), cap per-task retries so a poison
         # task (bad record / model bug) fails the shard loudly instead
@@ -91,7 +99,7 @@ class TaskDispatcher:
 
     def _create_training_tasks(self):
         tasks = self._shard_to_tasks(self._training_shards, TaskType.TRAINING)
-        random.shuffle(tasks)  # per-epoch shuffle (reference :76-85)
+        self._shuffle_rng.shuffle(tasks)  # per-epoch shuffle (reference :76-85)
         self._extend_todo(tasks)
 
     def _create_tasks_no_lock(self, shards, task_type, model_version=-1):

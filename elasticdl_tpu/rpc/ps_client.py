@@ -1,0 +1,189 @@
+"""Worker/master-side client for the sharded parameter server.
+
+One logical PS spread over N endpoints (master/ps_shard.py): every
+operation fans out to all shards on a thread pool — N concurrent RPCs
+on N sockets, so wire time scales down with the shard count (the
+whole point of sharding the PS; SURVEY §7.3 item 3). Slices follow
+`slice_boundaries`, computed locally from (n_params, num_shards).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.master.ps_shard import slice_boundaries
+from elasticdl_tpu.rpc.client import RpcClient
+
+
+class ShardedPS:
+    """Fan-out client over the PS shard endpoints."""
+
+    def __init__(self, endpoints: List[str], n_params: int):
+        if not endpoints:
+            raise ValueError("ShardedPS needs at least one endpoint")
+        self.endpoints = list(endpoints)
+        self.n_params = int(n_params)
+        self.bounds = slice_boundaries(self.n_params, len(endpoints))
+        self._clients = [RpcClient(ep) for ep in self.endpoints]
+        self._pool = ThreadPoolExecutor(
+            max_workers=len(endpoints), thread_name_prefix="ps-shard"
+        )
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.endpoints)
+
+    def wait_ready(self, timeout: float = 30.0):
+        self._map(lambda c, i: c.wait_ready(timeout))
+
+    def _map(self, fn):
+        """fn(client, shard_index) on every shard concurrently; returns
+        results in shard order, re-raising the first failure."""
+        futs = [
+            self._pool.submit(fn, c, i) for i, c in enumerate(self._clients)
+        ]
+        return [f.result() for f in futs]
+
+    # -- operations ----------------------------------------------------------
+
+    def init_model(self, vec: np.ndarray, version: int = 0) -> List[int]:
+        """Push initial slices (SETNX per shard); returns shard versions."""
+        vec = np.asarray(vec, dtype=np.float32)
+        if vec.size != self.n_params:
+            raise ValueError(f"init vec size {vec.size} != {self.n_params}")
+
+        def do(c, i):
+            s, e = self.bounds[i]
+            return c.call(
+                "PSInit", {"vec": vec[s:e], "version": version}
+            )["version"]
+
+        return self._map(do)
+
+    def pull(
+        self,
+        versions: Optional[List[int]] = None,
+        model_dtype: Optional[str] = None,
+    ) -> Tuple[List[int], Optional[np.ndarray]]:
+        """Assemble the full flat vector from all shards.
+
+        With `versions` given, shards at or below their known version
+        return no payload (only_if_newer) — if ANY shard advanced, the
+        stale slices are re-pulled so the result is complete. Returns
+        (shard_versions, vec|None): None when nothing advanced or the
+        PS is uninitialized."""
+        only_if_newer = versions is not None
+
+        def do(c, i):
+            req = {"only_if_newer": only_if_newer}
+            if only_if_newer:
+                req["version"] = versions[i]
+            if model_dtype:
+                req["model_dtype"] = model_dtype
+            return c.call("PSPull", req)
+
+        resps = self._map(do)
+        new_versions = [r["version"] for r in resps]
+        if any(v < 0 for v in new_versions):
+            return new_versions, None
+        if only_if_newer and all(r.get("vec") is None for r in resps):
+            return new_versions, None
+        missing = [i for i, r in enumerate(resps) if r.get("vec") is None]
+        if missing:
+
+            def refill(c, i):
+                req = {}
+                if model_dtype:
+                    req["model_dtype"] = model_dtype
+                return c.call("PSPull", req)
+
+            for i, r in zip(
+                missing,
+                [
+                    self._pool.submit(refill, self._clients[i], i)
+                    for i in missing
+                ],
+            ):
+                resps[i] = r.result()
+                new_versions[i] = resps[i]["version"]
+        return new_versions, self._assemble([r["vec"] for r in resps])
+
+    def push_delta(
+        self,
+        delta: np.ndarray,
+        steps: int,
+        base_versions: List[int],
+        model_dtype: Optional[str] = None,
+        want_model: bool = False,
+    ) -> Tuple[List[int], Dict[int, np.ndarray]]:
+        """Window-delta fan-out. Returns (shard_versions,
+        {shard_index: merged_slice}) — merged slices only for shards
+        whose version ran ahead of base+steps (or on want_model)."""
+        delta = np.asarray(delta)
+        if delta.size != self.n_params:
+            raise ValueError(f"delta size {delta.size} != {self.n_params}")
+
+        def do(c, i):
+            s, e = self.bounds[i]
+            req = {
+                "delta": delta[s:e],
+                "steps": steps,
+                "base_version": base_versions[i],
+                "want_model": want_model,
+            }
+            if model_dtype:
+                req["model_dtype"] = model_dtype
+            return c.call("PSPushDelta", req)
+
+        resps = self._map(do)
+        merged = {
+            i: r["vec"] for i, r in enumerate(resps) if r.get("vec") is not None
+        }
+        return [r["version"] for r in resps], merged
+
+    def push_grad(
+        self,
+        grad: np.ndarray,
+        versions: List[int],
+        model_dtype: Optional[str] = None,
+        return_model: bool = False,
+    ) -> Tuple[List[int], Optional[np.ndarray]]:
+        """Per-step gradient fan-out (async / windowed-sync shards).
+        Returns (shard_versions, full_model|None) — the model comes
+        back only when return_model was set and every shard advanced
+        past the reported version (async mode always advances)."""
+        grad = np.asarray(grad)
+        if grad.size != self.n_params:
+            raise ValueError(f"grad size {grad.size} != {self.n_params}")
+
+        def do(c, i):
+            s, e = self.bounds[i]
+            req = {
+                "grad": grad[s:e],
+                "version": versions[i],
+                "return_model": return_model,
+            }
+            if model_dtype:
+                req["model_dtype"] = model_dtype
+            return c.call("PSPushGrad", req)
+
+        resps = self._map(do)
+        new_versions = [r["version"] for r in resps]
+        vec = None
+        if return_model and all(r.get("vec") is not None for r in resps):
+            vec = self._assemble([r["vec"] for r in resps])
+        return new_versions, vec
+
+    def _assemble(self, slices: List[np.ndarray]) -> np.ndarray:
+        out = np.empty(self.n_params, dtype=np.asarray(slices[0]).dtype)
+        for (s, e), sl in zip(self.bounds, slices):
+            out[s:e] = sl
+        return out
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+        for c in self._clients:
+            c.close()

@@ -55,6 +55,11 @@ def main(argv=None) -> int:
 
     client = RpcClient(args.master_addr)
     client.wait_ready(timeout=60)
+    # sharded-PS discovery: always ask the master (argv can go stale
+    # across elastic relaunches; an empty list = classic single PS)
+    ps_endpoints = client.call("GetPSConfig", {}).get("endpoints") or None
+    if ps_endpoints:
+        logger.info("sharded PS: %d endpoints", len(ps_endpoints))
     worker = Worker(
         args.worker_id,
         client,
@@ -62,6 +67,7 @@ def main(argv=None) -> int:
         minibatch_size=args.minibatch_size,
         local_updates=args.local_updates,
         transport_dtype=args.transport_dtype,
+        ps_endpoints=ps_endpoints,
     )
     # device-level tracing (SURVEY §5.1): a jax.profiler trace of the
     # whole task loop, viewable in TensorBoard/Perfetto/XProf. The

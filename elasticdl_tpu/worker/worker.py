@@ -78,9 +78,18 @@ class Worker:
         flat_transport: bool = True,
         local_updates: int = 0,
         seed: int = 0,
+        ps_endpoints=None,  # sharded PS (master/ps_shard.py) fan-out
     ):
         self._id = worker_id
         self._master = master
+        # Sharded PS: the flat vector's slices live behind N endpoints
+        # and pushes/pulls fan out in parallel (rpc/ps_client.ShardedPS).
+        # The master stays the control plane (tasks, eval, metadata);
+        # model bandwidth rides the shards. Built lazily once the flat
+        # size is known (after the first pull/init via the master).
+        self._ps_endpoints = list(ps_endpoints) if ps_endpoints else None
+        self._ps = None
+        self._shard_versions = None  # per-shard version vector
         self._spec = model_spec
         self._minibatch_size = minibatch_size
         self._mesh = mesh
@@ -160,6 +169,11 @@ class Worker:
                 "local_updates mode does not support PS-resident "
                 "embeddings (sparse grads must reach the PS every step)"
             )
+        if ps_endpoints and model_spec.embedding_specs:
+            raise ValueError(
+                "sharded PS does not support elastic-embedding models "
+                "(mirrors the master-boot check)"
+            )
 
         self._readers = ReaderCache()
         self._train_step = None
@@ -178,6 +192,18 @@ class Worker:
         self._job_failed = resp.get("failed", False)
         return Task.from_wire(resp["task"]), resp.get("finished", False)
 
+    def _ensure_ps(self):
+        """Build the sharded-PS client once the flat size is known."""
+        if (
+            self._ps is None
+            and self._ps_endpoints
+            and self._flat is not None
+        ):
+            from elasticdl_tpu.rpc.ps_client import ShardedPS
+
+            self._ps = ShardedPS(self._ps_endpoints, int(self._flat.size))
+        return self._ps
+
     def pull_model(self, min_version: int = -1, method: str = MethodType.MINIMUM):
         """reference: worker.py:103-124 (var assign becomes pytree swap)."""
         use_flat = (
@@ -185,6 +211,35 @@ class Worker:
             and method == MethodType.MINIMUM
             and self._template is not None
         )
+        if use_flat and self._ensure_ps() is not None:
+            # sharded PS: assemble the model from all shards in parallel;
+            # per-shard only_if_newer makes the steady-state refresh
+            # proportional to what actually advanced
+            versions, vec = self._ps.pull(
+                versions=self._shard_versions,
+                model_dtype=(
+                    "bfloat16"
+                    if self._transport_dtype == "bfloat16"
+                    else None
+                ),
+            )
+            if any(v < 0 for v in versions):
+                return False  # shards not initialized yet
+            if vec is not None:
+                # shards hold only the dense vector; a refresh must also
+                # carry the matching non-trainable state, or this
+                # worker's stale aux would later overwrite newer aux at
+                # the master (single-PS pulls return both together)
+                aux = None
+                if self._aux:
+                    aux = self._master.call("GetAux", {}).get("aux")
+                self._set_flat(vec, aux)
+            with self._report_lock:
+                self._shard_versions = versions
+                self._version = min(versions)
+                self._base_version = self._version
+            self._fresh = True
+            return True
         req = {"version": min_version, "method": method}
         if method == MethodType.MINIMUM:
             req["only_if_newer"] = True
@@ -260,6 +315,37 @@ class Worker:
         grads_h, aux_h, loss_h = jax.device_get(
             (grads, aux_state or None, loss)
         )
+        if flat and self._ensure_ps() is not None:
+            # sharded PS per-step path (async/windowed-sync shards —
+            # strict-equality sync is refused at master boot): gradient
+            # slices fan out in parallel, the updated model slices come
+            # back the same way, and the tiny metadata (loss, aux,
+            # versions) goes to the master's control plane which drives
+            # the checkpoint/eval cadence + metrics sink.
+            model_dtype = (
+                "bfloat16" if self._transport_dtype == "bfloat16" else None
+            )
+            with self._report_lock:
+                base = self._shard_versions or [
+                    self._version
+                ] * self._ps.num_shards
+            versions, vec = self._ps.push_grad(
+                grads_h, base, model_dtype=model_dtype, return_model=True
+            )
+            meta = {
+                "worker_id": self._id,
+                "versions": versions,
+                "aux_state": aux_h,
+            }
+            if loss_h is not None:
+                meta["loss"] = float(loss_h)
+            self._master.call("ReportWindowMeta", meta)
+            with self._report_lock:
+                self._shard_versions = versions
+            resp = {"accepted": True, "version": min(versions)}
+            if vec is not None:
+                resp["params_flat"] = vec
+            return resp, loss_h
         req = {
             "worker_id": self._id,
             "version": self._version,
@@ -770,11 +856,49 @@ class Worker:
                 req["model_dtype"] = "bfloat16"
             if step_loss_h is not None:
                 req["loss"] = float(step_loss_h)  # master's metrics sink
-            resp = self._master.call("ReportLocalUpdate", req)
+            if self._ensure_ps() is not None:
+                # sharded PS: the delta fans out to all shards in
+                # parallel; the master gets only the tiny window
+                # metadata (loss/aux/versions) that drives its
+                # checkpoint/eval cadence and metrics sink
+                with self._report_lock:
+                    base_versions = (
+                        list(self._shard_versions)
+                        if self._shard_versions
+                        else [base_version] * self._ps.num_shards
+                    )
+                versions, merged = self._ps.push_delta(
+                    delta_h,
+                    steps,
+                    base_versions,
+                    model_dtype=req.get("model_dtype"),
+                )
+                meta = {
+                    "worker_id": self._id,
+                    "versions": versions,
+                    "steps": steps,
+                    "aux_state": aux_h,
+                    # absorbed merged slices need the matching
+                    # non-trainable state (single-PS parity: the
+                    # report_local_update response carries aux)
+                    "want_aux": bool(merged),
+                }
+                if step_loss_h is not None:
+                    meta["loss"] = float(step_loss_h)
+                meta_resp = self._master.call("ReportWindowMeta", meta)
+                resp = {"version": min(versions)}
+                if merged:
+                    resp["params_flat"] = merged
+                    resp["aux"] = meta_resp.get("aux")
+            else:
+                versions = None
+                resp = self._master.call("ReportLocalUpdate", req)
             with self._report_lock:
                 if epoch != self._sync_epoch:
                     return  # reset raced the RPC: discard the response
                 self._synced_seq = max(self._synced_seq, seq)
+                if versions is not None:
+                    self._shard_versions = versions
                 self._version = resp["version"]
                 self._base_version = resp["version"]
                 self._fresh = True
@@ -910,7 +1034,18 @@ class Worker:
                 del self._base_snapshots[k]
             if snap is None:
                 return  # reset raced the response: state discarded
-            merged = jnp.asarray(np.asarray(params_flat, dtype=np.float32))
+            if isinstance(params_flat, dict):
+                # sharded PS: merged slices only for the shards whose
+                # version ran ahead — splice them over the snapshot
+                # (shift is zero on the untouched slices by construction)
+                merged = snap
+                for i, sl in params_flat.items():
+                    s, e = self._ps.bounds[i]
+                    merged = merged.at[s:e].set(
+                        jnp.asarray(np.asarray(sl, dtype=np.float32))
+                    )
+            else:
+                merged = jnp.asarray(np.asarray(params_flat, dtype=np.float32))
             shift = merged - snap
             for k in list(self._base_snapshots):  # younger, unsettled
                 self._base_snapshots[k] = self._base_snapshots[k] + shift
@@ -1194,19 +1329,26 @@ class Worker:
             # executable (the AOT stage does not seed the jit call
             # cache), so an elastic relaunch must not pay it — only
             # bench.py sets the flag. Best-effort: cost_analysis is
-            # not on every backend.
+            # not on every backend. XLA counts a lax.scan (while-loop)
+            # body ONCE regardless of trip count, so the W-step window
+            # program reports ~1 step's FLOPs; lower a W=1 window and
+            # scale by the window length instead.
             try:
+                one = jax.tree_util.tree_map(lambda a: a[:1], (features, labels))
                 cost = (
                     self._local_window_fn.lower(
                         jnp.copy(self._flat), opt_state, self._aux,
-                        features, labels,
+                        one[0], one[1],
                     )
                     .compile()
                     .cost_analysis()
                 )
                 if isinstance(cost, (list, tuple)):
                     cost = cost[0]
-                self.window_flops = float(cost.get("flops", 0.0)) or None
+                step_flops = float(cost.get("flops", 0.0))
+                self.window_flops = (
+                    step_flops * self._local_updates if step_flops else None
+                )
             except Exception:
                 self.window_flops = None
         out = self._local_window_fn(
@@ -1323,3 +1465,5 @@ class Worker:
             self._finalize_local_updates()
         finally:
             self._readers.close()
+            if self._ps is not None:
+                self._ps.close()

@@ -96,6 +96,30 @@ def test_tensorboard_service_manifest():
     assert svc["spec"]["ports"][0]["port"] == 6006
 
 
+def test_ps_pod_manifest():
+    """PS shard pods share the worker pod shape but carry replica type
+    "ps" so the worker watch/relaunch machinery ignores them."""
+    from elasticdl_tpu.cluster.k8s_backend import (
+        build_ps_pod_manifest,
+        ps_pod_name,
+    )
+
+    pod = build_ps_pod_manifest(
+        "job1",
+        1,
+        "img:latest",
+        ["python", "-m", "elasticdl_tpu.master.ps_shard_main"],
+        resource_request="cpu=1,memory=1024Mi",
+    )
+    assert pod["metadata"]["name"] == ps_pod_name("job1", 1) == (
+        "elasticdl-job1-ps-1"
+    )
+    labels = pod["metadata"]["labels"]
+    assert labels["elasticdl-replica-type"] == "ps"
+    assert labels["elasticdl-job-name"] == "job1"
+    assert pod["spec"]["containers"][0]["name"] == "ps"
+
+
 # -- WorkerManager elasticity over a fake backend ---------------------------
 
 
