@@ -158,6 +158,36 @@ def test_plain_fast_path_matches_reference():
     )
 
 
+def test_remat_matches_non_remat():
+    """cfg.remat (per-layer jax.checkpoint) must not change values or
+    gradients — it only trades recompute FLOPs for activation memory,
+    on both the plain fast path and the sharded stage scan."""
+    import dataclasses
+
+    rng = np.random.default_rng(0)
+    params = init_params(rng, DENSE_CFG)
+    tokens = _tokens(rng, b=4, l=16)
+    remat_cfg = dataclasses.replace(DENSE_CFG, remat=True)
+
+    mesh1 = _mesh((1, 1, 1, 1))
+    base, remat = build_loss_fn(DENSE_CFG, mesh1), build_loss_fn(remat_cfg, mesh1)
+    assert abs(float(base(params, tokens)) - float(remat(params, tokens))) < 1e-6
+    g0 = jax.grad(base)(params, tokens)
+    g1 = jax.grad(remat)(params, tokens)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        g0,
+        g1,
+    )
+
+    mesh = _mesh((2, 2, 2, 1))
+    sharded = build_loss_fn(remat_cfg, mesh)
+    p = place_params(init_params(np.random.default_rng(0), remat_cfg), remat_cfg, mesh)
+    assert abs(float(sharded(p, tokens)) - float(base(params, tokens))) < 2e-4
+
+
 def test_moe_single_device_keeps_shard_map_path():
     mesh1 = _mesh((1, 1, 1, 1))
     fn = build_loss_fn(MOE_CFG, mesh1)
