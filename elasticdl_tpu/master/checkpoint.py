@@ -19,7 +19,9 @@ doc/distributed_embedding_layer_design.md:425-428).
 from __future__ import annotations
 
 import os
+import queue
 import tempfile
+import threading
 from typing import Any, Dict, Optional
 
 from elasticdl_tpu.common import codec
@@ -75,6 +77,21 @@ class CheckpointService:
         self._eval_models: Dict[int, str] = {}
         if include_evaluation:
             self._eval_checkpoint_dir = tempfile.mkdtemp(prefix="edl_tpu_evalckpt_")
+        # Durable checkpoints write on a background thread: the save is
+        # triggered from a gradient-report RPC handler (the snapshot
+        # itself is copied under the servicer lock), and a multi-second
+        # serialize+write of a large model must not stall that worker's
+        # response. Eval snapshots stay synchronous — a worker may
+        # GetModel(FIXED) the pinned version immediately after the
+        # trigger. A write failure is logged, never raised into
+        # training (checkpoints are optional output, README.md:10-12).
+        # The queue is BOUNDED: each item holds a full param snapshot,
+        # so a disk slower than the cadence must apply backpressure
+        # (save blocks like the old sync path) instead of accumulating
+        # multi-GB copies until the master OOMs.
+        self._write_q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._writer: Optional[threading.Thread] = None
+        self._writer_lock = threading.Lock()
 
     def is_enabled(self) -> bool:
         return bool(self._steps)
@@ -103,24 +120,65 @@ class CheckpointService:
         return os.path.join(d, f"model_v{version}.ckpt")
 
     def save(self, params: Any, version: int, is_eval: bool = False, aux: Any = None):
-        """reference: checkpoint_service.py:47-72 (rotation included)."""
+        """reference: checkpoint_service.py:47-72 (rotation included).
+        Durable saves are queued to the background writer; eval
+        snapshots write synchronously (see __init__)."""
         path = self._path(version, is_eval)
         emb = None
         if not is_eval and self._embedding_store is not None:
             emb = self._embedding_store.snapshot()
-        save_model_file(path, params, version, aux=aux, embeddings=emb)
         if is_eval:
+            save_model_file(path, params, version, aux=aux, embeddings=emb)
             self._eval_models[version] = path
-        else:
-            logger.info("Checkpoint saved: %s", path)
-            self._checkpoint_list.append(path)
-            if self._max_versions:
-                while len(self._checkpoint_list) > self._max_versions:
-                    stale = self._checkpoint_list.pop(0)
-                    try:
-                        os.remove(stale)
-                    except FileNotFoundError:
-                        pass
+            return
+        with self._writer_lock:
+            # save() runs on the 64-thread RPC pool: without the lock,
+            # two cadence-crossing reports could each start a writer,
+            # and two writers would race the rotation list
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._writer_loop, daemon=True
+                )
+                self._writer.start()
+        self._write_q.put((path, params, version, aux, emb))
+
+    def _writer_loop(self):
+        while True:
+            item = self._write_q.get()
+            try:
+                if item is None:
+                    return
+                path, params, version, aux, emb = item
+                save_model_file(path, params, version, aux=aux, embeddings=emb)
+                logger.info("Checkpoint saved: %s", path)
+                self._checkpoint_list.append(path)
+                if self._max_versions:
+                    while len(self._checkpoint_list) > self._max_versions:
+                        stale = self._checkpoint_list.pop(0)
+                        try:
+                            os.remove(stale)
+                        except FileNotFoundError:
+                            pass
+            except Exception:
+                logger.exception("checkpoint write failed (training continues)")
+            finally:
+                self._write_q.task_done()
+
+    def flush(self):
+        """Block until every queued durable write has landed — call
+        before reading checkpoints back or tearing the job down."""
+        self._write_q.join()
+
+    def close(self):
+        """Drain pending writes and stop the writer thread (job
+        teardown; a closed service can still save — the writer
+        restarts lazily)."""
+        self.flush()
+        with self._writer_lock:
+            writer, self._writer = self._writer, None
+        if writer is not None:
+            self._write_q.put(None)
+            writer.join(timeout=30)
 
     # -- evaluation snapshots (FIXED model pulls) ----------------------------
 
@@ -143,10 +201,12 @@ class CheckpointService:
     # -- lookup by version (reference: checkpoint_service.py:80-108) ---------
 
     def load_version(self, version: int) -> Optional[Model]:
+        self.flush()  # the version may still be in the write queue
         path = self._path(version, is_eval=False)
         if not os.path.exists(path):
             return None
         return load_model_file(path)
 
     def latest_path(self) -> Optional[str]:
+        self.flush()
         return self._checkpoint_list[-1] if self._checkpoint_list else None

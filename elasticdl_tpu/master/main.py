@@ -363,6 +363,7 @@ def main(argv=None) -> int:
     finally:
         logger.info("Worker manager status: %s", WorkerManagerStatus.FINISHED)
         manager.stop_relaunch_and_remove_workers()
+        ckpt.close()  # queued async checkpoint writes must land
         if eval_service is not None:
             eval_service.stop()
         if servicer.tb_service is not None:

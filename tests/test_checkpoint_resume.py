@@ -48,6 +48,7 @@ def test_rotation_keeps_last_k(tmp_path):
         checkpoint_steps=1,
         keep_checkpoint_max=2,
     )
+    ckpt.flush()  # saves ride the async writer
     files = sorted(os.listdir(ckpt_dir))
     assert len(files) == 2, files  # ring buffer pruned older snapshots
     # the retained versions are loadable by exact version
@@ -82,6 +83,38 @@ def test_resume_from_checkpoint_continues_version(tmp_path):
     worker = Worker(0, InProcessMaster(servicer2), spec, minibatch_size=16)
     assert worker.run()
     assert servicer2.version > v1  # training continued from the saved version
+
+
+def test_async_writer_does_not_block_save(tmp_path, monkeypatch):
+    """Durable saves are queued to a background writer: a slow disk
+    must not stall the caller (a gradient-report RPC handler), and
+    flush() must make every queued write durable."""
+    import time
+
+    import elasticdl_tpu.master.checkpoint as ckpt_mod
+    from elasticdl_tpu.master.checkpoint import CheckpointService
+
+    real_save = ckpt_mod.save_model_file
+    delay = 0.3
+
+    def slow_save(path, params, version, aux=None, embeddings=None):
+        time.sleep(delay)
+        real_save(path, params, version, aux=aux, embeddings=embeddings)
+
+    monkeypatch.setattr(ckpt_mod, "save_model_file", slow_save)
+    service = CheckpointService(
+        checkpoint_dir=str(tmp_path / "ckpts"), checkpoint_steps=1
+    )
+    params = {"w": np.ones(4, np.float32)}
+    t0 = time.time()
+    for v in (1, 2, 3):
+        service.save(params, v)
+    enqueue_time = time.time() - t0
+    assert enqueue_time < delay, "save() must not wait on the disk"
+    service.flush()
+    files = sorted(os.listdir(str(tmp_path / "ckpts")))
+    assert files == ["model_v1.ckpt", "model_v2.ckpt", "model_v3.ckpt"]
+    assert service.load_version(2).version == 2
 
 
 def test_embedding_snapshot_roundtrip_via_file(tmp_path):

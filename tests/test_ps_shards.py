@@ -232,6 +232,7 @@ def test_sharded_checkpoint_cadence_via_window_meta(tmp_path):
         )
         assert worker.run()
         worker.close()
+        ckpt.flush()  # saves ride the async writer
         saved = [f for f in os.listdir(ckpt_dir) if f.endswith(".ckpt")]
         assert saved, "cadence crossings must produce checkpoints"
         assert servicer.version > 0  # the mirror advanced via meta
