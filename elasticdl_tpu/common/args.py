@@ -139,6 +139,13 @@ def add_master_args(parser: argparse.ArgumentParser):
         "--max_worker_relaunches", type=non_neg_int, default=10,
         help="total replacement workers to launch before giving up",
     )
+    parser.add_argument(
+        "--num_standby_workers", type=non_neg_int, default=0,
+        help="warm standby workers held in reserve (pre-booted and "
+        "AOT-compiled); a standby is promoted instantly when an active "
+        "worker dies, removing the boot/compile transient from "
+        "preemption recovery",
+    )
     parser.add_argument("--worker_image", default="")
     parser.add_argument("--namespace", default="default")
     parser.add_argument(

@@ -92,6 +92,12 @@ class CheckpointService:
         self._write_q: "queue.Queue" = queue.Queue(maxsize=2)
         self._writer: Optional[threading.Thread] = None
         self._writer_lock = threading.Lock()
+        # flush() waits on counters, not queue.join(): join would also
+        # wait for saves enqueued AFTER the flush call, which never
+        # terminates when training checkpoints faster than the disk
+        self._write_cv = threading.Condition()
+        self._enqueued = 0
+        self._written = 0
 
     def is_enabled(self) -> bool:
         return bool(self._steps)
@@ -140,14 +146,16 @@ class CheckpointService:
                     target=self._writer_loop, daemon=True
                 )
                 self._writer.start()
+        with self._write_cv:
+            self._enqueued += 1
         self._write_q.put((path, params, version, aux, emb))
 
     def _writer_loop(self):
         while True:
             item = self._write_q.get()
+            if item is None:
+                return
             try:
-                if item is None:
-                    return
                 path, params, version, aux, emb = item
                 save_model_file(path, params, version, aux=aux, embeddings=emb)
                 logger.info("Checkpoint saved: %s", path)
@@ -162,12 +170,17 @@ class CheckpointService:
             except Exception:
                 logger.exception("checkpoint write failed (training continues)")
             finally:
-                self._write_q.task_done()
+                with self._write_cv:
+                    self._written += 1
+                    self._write_cv.notify_all()
 
     def flush(self):
-        """Block until every queued durable write has landed — call
-        before reading checkpoints back or tearing the job down."""
-        self._write_q.join()
+        """Block until every write queued BEFORE this call has landed
+        (later saves are not waited on — an open-ended wait would never
+        return when the cadence outruns the disk)."""
+        with self._write_cv:
+            target = self._enqueued
+            self._write_cv.wait_for(lambda: self._written >= target)
 
     def close(self):
         """Drain pending writes and stop the writer thread (job
@@ -201,8 +214,14 @@ class CheckpointService:
     # -- lookup by version (reference: checkpoint_service.py:80-108) ---------
 
     def load_version(self, version: int) -> Optional[Model]:
-        self.flush()  # the version may still be in the write queue
         path = self._path(version, is_eval=False)
+        # writes land atomically (tmp+rename), so an existing file is
+        # complete — serve it WITHOUT flush(): queue.join() waits on
+        # saves enqueued after the call too, and training that
+        # checkpoints faster than the disk drains would wedge a
+        # GetModel(FIXED) RPC here indefinitely
+        if not os.path.exists(path):
+            self.flush()  # the version may still be in the write queue
         if not os.path.exists(path):
             return None
         return load_model_file(path)

@@ -59,6 +59,37 @@ def collect_shards(path: str) -> dict:
     return shards
 
 
+def make_sample_batch_fn(training_data_dir: str):
+    """Serves the first n raw records of the first training shard —
+    standby workers AOT-compile against this sample (the master reads
+    the same shards to count records, so access is a given)."""
+
+    def fn(n: int):
+        from elasticdl_tpu.data.recordio import RecordIOReader
+
+        shards = collect_shards(training_data_dir)
+        records: list = []
+        # top up across shards: a short (or empty) first shard must not
+        # shrink the sample below the minibatch — the standby would
+        # AOT-compile a wrong-shape program and silently pay the full
+        # compile on promotion anyway
+        for path in sorted(shards):
+            take = min(n - len(records), shards[path])
+            if take > 0:
+                with RecordIOReader(path) as reader:
+                    records.extend(reader.read_range(0, take))
+            if len(records) >= n:
+                break
+        if records and len(records) < n:
+            logger.warning(
+                "sample batch short: %d/%d records — standby pre-warm "
+                "will compile a non-hot shape", len(records), n,
+            )
+        return records or None
+
+    return fn
+
+
 def build_master(args, job_type: str, cluster_backend=None):
     """Dispatcher + servicer + services, shared by main() and tests.
     `cluster_backend` (a K8sBackend) is required only when a sharded PS
@@ -331,7 +362,14 @@ def main(argv=None) -> int:
         worker_argv_fn=lambda wid: worker_forward_args(args, wid, addr),
         envs=parse_envs(args.envs),
         max_relaunches=args.max_worker_relaunches,
+        num_standby=args.num_standby_workers,
     )
+    if args.num_standby_workers:
+        servicer.set_standby_fn(manager.is_standby)
+        if args.training_data_dir:
+            servicer.set_sample_batch_fn(
+                make_sample_batch_fn(args.training_data_dir)
+            )
     manager.start_workers()
     logger.info("Worker manager status: %s", WorkerManagerStatus.RUNNING)
 

@@ -116,7 +116,24 @@ class MasterServicer:
             "GetPSConfig": self.get_ps_config,
             "ReportWindowMeta": self.report_window_meta,
             "GetAux": self.get_aux,
+            "GetSampleBatch": self.get_sample_batch,
         }
+
+    def set_standby_fn(self, fn):
+        """fn(worker_id) -> bool; wired to WorkerManager.is_standby."""
+        self._standby_fn = fn
+
+    def set_sample_batch_fn(self, fn):
+        """fn(n) -> list[bytes]; serves raw records for standby
+        pre-warming (the master already reads the shards to count
+        records, so it has data access by construction)."""
+        self._sample_batch_fn = fn
+
+    def get_sample_batch(self, req: dict) -> dict:
+        fn = getattr(self, "_sample_batch_fn", None)
+        if fn is None:
+            return {"records": None}
+        return {"records": fn(int(req.get("n", 1)))}
 
     # -- model state --------------------------------------------------------
 
@@ -161,7 +178,20 @@ class MasterServicer:
         """reference: servicer.py:98-115 — next shard or WAIT.
 
         Adds an explicit `finished` flag so workers exit cleanly instead
-        of inferring job completion from an empty shard name."""
+        of inferring job completion from an empty shard name. Standby
+        workers (worker_manager.is_standby) are held in reserve: WAIT +
+        standby=True, which tells them to pre-warm (pull model, AOT
+        compile on a sample batch) so promotion costs nothing."""
+        standby_fn = getattr(self, "_standby_fn", None)
+        if standby_fn is not None and standby_fn(req["worker_id"]):
+            finished = self._task_d.finished() if self._task_d else True
+            if finished and self._evaluation_service is not None:
+                finished = not self._evaluation_service.has_pending()
+            return {
+                "task": Task(type=TaskType.WAIT).to_wire(),
+                "finished": finished,
+                "standby": True,
+            }
         task = self._task_d.get(req["worker_id"]) if self._task_d else None
         if task is None:
             finished = self._task_d.finished() if self._task_d else True

@@ -13,6 +13,17 @@ backend-agnostic pod interface:
 - a relaunch budget bounds crash loops (the reference relaunches
   forever; a poison image would flap pods indefinitely);
 - `stop_relaunch_and_remove_workers()` for teardown (:100-104).
+
+Beyond the reference: **warm standby workers** (`num_standby`). A
+standby is a fully booted worker process the dispatcher refuses tasks
+to (the servicer consults `is_standby`); it pre-pulls the model and
+AOT-compiles its train program against a master-served sample batch,
+then idles. When an active worker dies, a standby is PROMOTED in the
+event callback — no process boot, no jax import, no compile in the
+recovery path — and a replacement standby is launched in the
+background to refill the pool. This converts the relaunch transient
+(tens of seconds to minutes of python+jax+XLA boot, the dominant cost
+of preemption churn) into one task-requeue RPC round.
 """
 
 from __future__ import annotations
@@ -38,18 +49,22 @@ class WorkerManager:
         worker_argv_fn: Callable[[int], List[str]],
         envs: Optional[Dict[str, str]] = None,
         max_relaunches: int = 10,
+        num_standby: int = 0,
     ):
         self._backend = backend
         self._task_d = task_dispatcher
         self._num_workers = num_workers
+        self._num_standby = num_standby
         self._argv_fn = worker_argv_fn
         self._envs = envs or {}
         self._max_relaunches = max_relaunches
         self._lock = threading.Lock()
         self._next_id = 0
         self._relaunches = 0
+        self._promotions = 0
         self._relaunch = True
         self._phases: Dict[int, str] = {}
+        self._standby: set = set()  # worker ids held in reserve
         self._live = 0
         backend.set_event_callback(self._event_cb)
 
@@ -59,17 +74,28 @@ class WorkerManager:
         """reference: k8s_worker_manager.py:86-88."""
         for _ in range(self._num_workers):
             self._start_one()
+        for _ in range(self._num_standby):
+            self._start_one(standby=True)
 
-    def _start_one(self, live_reserved: bool = False):
+    def _start_one(self, live_reserved: bool = False, standby: bool = False):
         with self._lock:
             worker_id = self._next_id
             self._next_id += 1
             self._phases[worker_id] = PodPhase.PENDING
+            if standby:
+                # marked BEFORE the process starts so its first GetTask
+                # already sees standby=True
+                self._standby.add(worker_id)
             if not live_reserved:
                 self._live += 1
         self._backend.start_worker(
             worker_id, self._argv_fn(worker_id), self._envs
         )
+
+    def is_standby(self, worker_id: int) -> bool:
+        """Servicer hook: standby workers get WAIT instead of tasks."""
+        with self._lock:
+            return worker_id in self._standby
 
     def stop_relaunch_and_remove_workers(self):
         """reference: k8s_worker_manager.py:100-104."""
@@ -104,13 +130,23 @@ class WorkerManager:
             if self._phases.get(event.worker_id) in _TERMINAL:
                 return
             self._phases[event.worker_id] = event.phase
+            dead_standby = False
+            promoted = None
             if done:
                 self._live = max(0, self._live - 1)
+                dead_standby = event.worker_id in self._standby
+                self._standby.discard(event.worker_id)
+            recoverable = done and not completed and self._relaunch
+            if recoverable and not dead_standby and self._standby:
+                # a warm standby takes over INSTANTLY (no boot/compile
+                # in the recovery path). Promotion launches nothing, so
+                # it is NOT budget-gated — only the background refill
+                # below is; with the budget spent the pool just shrinks
+                promoted = min(self._standby)
+                self._standby.discard(promoted)
+                self._promotions += 1
             should_relaunch = (
-                done
-                and not completed
-                and self._relaunch
-                and self._relaunches < self._max_relaunches
+                recoverable and self._relaunches < self._max_relaunches
             )
             if should_relaunch:
                 self._relaunches += 1
@@ -120,18 +156,25 @@ class WorkerManager:
                 self._live += 1
         if not done:
             return
-        if event.phase != PodPhase.SUCCEEDED:
+        if event.phase != PodPhase.SUCCEEDED and not dead_standby:
             # the dead worker's in-flight shards go back to todo; its
             # stale gradients are already harmless (version check)
             logger.info(
-                "Worker %d %s: recovering tasks%s",
+                "Worker %d %s: recovering tasks%s%s",
                 event.worker_id,
                 event.phase,
+                f", promoting standby {promoted}" if promoted is not None else "",
                 ", relaunching" if should_relaunch else "",
             )
             self._task_d.recover_tasks(event.worker_id)
         if should_relaunch:
-            self._start_one(live_reserved=True)
+            # replacement joins as a standby when one was promoted (the
+            # promoted worker already restored active capacity), or
+            # when the dead worker itself was a standby
+            self._start_one(
+                live_reserved=True,
+                standby=promoted is not None or dead_standby,
+            )
 
     # -- introspection ------------------------------------------------------
 
@@ -146,6 +189,10 @@ class WorkerManager:
     def relaunches(self) -> int:
         with self._lock:
             return self._relaunches
+
+    def promotions(self) -> int:
+        with self._lock:
+            return self._promotions
 
     def all_exited(self) -> bool:
         with self._lock:

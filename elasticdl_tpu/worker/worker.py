@@ -158,6 +158,8 @@ class Worker:
         self._flushed_report_ids: set = set()  # ids already reported by a flush
         self._report_lock = threading.Lock()  # main + sync threads
         self._job_failed = False  # master reported partial completion
+        self._is_standby = False  # master holds this worker in reserve
+        self._standby_warmed = False  # pre-warm done (model + compile)
         self.last_loss = None  # final minibatch loss of the last task
         self.task_losses: list = []  # last loss of each training task
         # per-phase wall-clock mirroring the reference's timing study
@@ -190,6 +192,7 @@ class Worker:
     def get_task(self):
         resp = self._master.call("GetTask", {"worker_id": self._id})
         self._job_failed = resp.get("failed", False)
+        self._is_standby = resp.get("standby", False)
         return Task.from_wire(resp["task"]), resp.get("finished", False)
 
     def _ensure_ps(self):
@@ -1404,6 +1407,8 @@ class Worker:
                         return False
                     logger.info("Worker %d: job finished, exiting", self._id)
                     return True
+                if self._is_standby and not self._standby_warmed:
+                    self._standby_prewarm()
                 with self.timers.phase("wait_poll"):
                     time.sleep(0.05)
                 continue
@@ -1441,6 +1446,45 @@ class Worker:
                     self._flushed_report_ids.discard(task.task_id)
                 if not reported and not flushed:
                     self.report_task_result(task.task_id, err)
+
+    def _standby_prewarm(self):
+        """Warm-standby boot: pull the model and AOT-compile the train
+        program against a master-served sample batch, so promotion to
+        active costs one RPC round instead of the full python+jax+XLA
+        boot (the dominant relaunch cost under preemption churn). Any
+        failure just leaves the standby cold — it still trains
+        correctly on promotion, only slower to start."""
+        try:
+            resp = self._master.call(
+                "GetSampleBatch", {"n": self._minibatch_size}
+            )
+            records = resp.get("records")
+            if not records:
+                self._standby_warmed = True  # nothing to warm against
+                return
+            features, labels = self._spec.dataset_fn(records, Mode.TRAINING)
+            if self._local_updates > 1:
+                stack = lambda a: np.stack(  # noqa: E731
+                    [np.asarray(a)] * self._local_updates
+                )
+                self.warmup_local_window(
+                    jax.tree_util.tree_map(stack, features),
+                    jax.tree_util.tree_map(stack, labels),
+                )
+            elif self._local_updates == 0:
+                self.warmup_sync_step(features, labels)
+            else:
+                # per-step local mode compiles lazily on the first real
+                # batch; the model pull below still pre-warms the rest
+                self._warmup_params(features)
+            self._standby_warmed = True
+            logger.info("Worker %d: standby pre-warm complete", self._id)
+        except Exception:
+            logger.exception(
+                "Worker %d: standby pre-warm failed (will warm on "
+                "promotion instead)", self._id,
+            )
+            self._standby_warmed = True  # do not retry-loop a hard failure
 
     def _finalize_local_updates(self):
         """Drain local-update state before exit: join the in-flight

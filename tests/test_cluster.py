@@ -146,7 +146,7 @@ class FakeBackend(PodBackend):
         self._cb(PodEvent(worker_id, phase, exit_code=exit_code))
 
 
-def _manager(num_workers=2, max_relaunches=10):
+def _manager(num_workers=2, max_relaunches=10, num_standby=0):
     dispatcher = TaskDispatcher({"f": 64}, {}, {}, 16, 1)
     backend = FakeBackend()
     manager = WorkerManager(
@@ -155,6 +155,7 @@ def _manager(num_workers=2, max_relaunches=10):
         num_workers=num_workers,
         worker_argv_fn=lambda wid: ["--worker_id", str(wid)],
         max_relaunches=max_relaunches,
+        num_standby=num_standby,
     )
     return manager, backend, dispatcher
 
@@ -200,6 +201,67 @@ def test_relaunch_budget_bounds_crash_loop():
         backend.fire(wid, PodPhase.FAILED, exit_code=1)
     assert len(backend.started) == 1 + 3  # initial + budget
     assert manager.all_exited()
+
+
+def test_standby_promoted_on_active_death():
+    """A warm standby takes over instantly when an active worker dies:
+    the dead worker's tasks are requeued, the standby leaves reserve
+    (so the dispatcher starts feeding it), and the relaunch refills the
+    standby pool instead of replacing active capacity."""
+    manager, backend, dispatcher = _manager(num_workers=2, num_standby=1)
+    manager.start_workers()
+    assert [wid for wid, _ in backend.started] == [0, 1, 2]
+    assert manager.is_standby(2) and not manager.is_standby(0)
+    t = dispatcher.get(0)
+    assert t is not None
+    before = dispatcher.pending_count()
+    backend.fire(0, PodPhase.DELETED)
+    assert dispatcher.pending_count() == before + 1  # task requeued
+    assert manager.promotions() == 1
+    assert not manager.is_standby(2)  # promoted: now gets tasks
+    # the refill joined as the NEW standby
+    assert [wid for wid, _ in backend.started] == [0, 1, 2, 3]
+    assert manager.is_standby(3)
+    assert manager.live_workers() == 3  # 2 active + 1 standby
+
+
+def test_dead_standby_refilled_without_recovery():
+    """A dying standby has no tasks to recover; it is just replaced."""
+    manager, backend, dispatcher = _manager(num_workers=1, num_standby=1)
+    manager.start_workers()
+    before = dispatcher.pending_count()
+    backend.fire(1, PodPhase.FAILED, exit_code=1)
+    assert dispatcher.pending_count() == before  # nothing requeued
+    assert manager.promotions() == 0
+    assert [wid for wid, _ in backend.started] == [0, 1, 2]
+    assert manager.is_standby(2)
+
+
+def test_promotion_not_gated_on_relaunch_budget():
+    """Promotion launches nothing, so a spent relaunch budget must not
+    strand a warm standby while the job wedges on WAIT."""
+    manager, backend, dispatcher = _manager(
+        num_workers=1, num_standby=1, max_relaunches=0
+    )
+    manager.start_workers()
+    t = dispatcher.get(0)
+    assert t is not None
+    backend.fire(0, PodPhase.DELETED)
+    assert manager.promotions() == 1
+    assert not manager.is_standby(1)  # promoted despite zero budget
+    assert len(backend.started) == 2  # no refill: budget is spent
+    assert manager.live_workers() == 1
+
+
+def test_no_standby_falls_back_to_plain_relaunch():
+    manager, backend, _ = _manager(num_workers=1, num_standby=1)
+    manager.start_workers()
+    backend.fire(1, PodPhase.DELETED)  # burn the standby first
+    backend.fire(0, PodPhase.DELETED)  # active dies with pool empty...
+    # ...before the refill (id 2) reports anything: id 2 IS the pool
+    assert manager.promotions() == 1  # refill standby got promoted
+    # and another refill was launched for it
+    assert [wid for wid, _ in backend.started] == [0, 1, 2, 3]
 
 
 def test_stop_relaunch_suppresses_replacement():

@@ -184,6 +184,74 @@ def test_multiprocess_training_job_sharded_ps(tmp_path):
     assert model.version > 0
 
 
+def test_standby_promotion_e2e(tmp_path):
+    """Warm-standby elasticity with real processes: 1 active + 1
+    pre-warmed standby; the active is SIGKILLed mid-job, the standby is
+    promoted (no new boot in the recovery path) and finishes the job
+    with no dropped tasks."""
+    from elasticdl_tpu.cluster.pod_backend import ProcessBackend
+    from elasticdl_tpu.common.args import master_parser, worker_forward_args
+    from elasticdl_tpu.master.main import build_master, make_sample_batch_fn
+    from elasticdl_tpu.master.worker_manager import WorkerManager
+    from elasticdl_tpu.rpc.server import RpcServer
+
+    tmp = str(tmp_path)
+    _write_shards(tmp, n_files=2, records_each=64)
+    args = master_parser().parse_args(
+        [
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--training_data_dir", tmp,
+            "--records_per_task", "32",
+            "--num_epochs", "8",
+            "--grads_to_wait", "1",
+            "--local_updates", "2",
+            "--num_workers", "1",
+            "--num_standby_workers", "1",
+            "--worker_backend", "process",
+        ]
+    )
+    spec, dispatcher, servicer, _evs, _ckpt = build_master(args, "training")
+    server = RpcServer(servicer.handlers(), port=0)
+    server.start()
+    addr = f"localhost:{server.port}"
+    backend = ProcessBackend(log_dir=os.path.join(tmp, "wlogs"))
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=1,
+        worker_argv_fn=lambda wid: worker_forward_args(args, wid, addr),
+        envs={"JAX_PLATFORMS": "cpu"},
+        max_relaunches=4,
+        num_standby=1,
+    )
+    servicer.set_standby_fn(manager.is_standby)
+    servicer.set_sample_batch_fn(make_sample_batch_fn(tmp))
+    manager.start_workers()
+    try:
+        deadline = time.time() + 300
+        killed = False
+        while not dispatcher.finished():
+            assert time.time() < deadline, "job stuck"
+            assert not manager.all_exited(), "all workers gone"
+            if not killed and dispatcher.completed_records() > 0:
+                pid = backend.pid_of(0)
+                if pid:
+                    os.kill(pid, signal.SIGKILL)
+                    killed = True
+            time.sleep(0.05)
+        assert killed
+        assert manager.promotions() == 1
+        assert not dispatcher.has_failed_tasks()
+        # the promoted standby (id 1) did the remaining work; the
+        # refill standby (id 2) idled — both must exit cleanly at end
+    finally:
+        manager.stop_relaunch_and_remove_workers()
+        backend.stop()
+        server.stop()
+
+
 def test_job_with_failed_tasks_exits_nonzero(tmp_path):
     """A poison shard (undecodable records) exhausts task retries; the
     master exit path must report failure (exit code 2), not success."""
