@@ -184,18 +184,20 @@ def test_multiprocess_training_job_sharded_ps(tmp_path):
     assert model.version > 0
 
 
-def test_standby_promotion_e2e(tmp_path):
-    """Warm-standby elasticity with real processes: 1 active + 1
-    pre-warmed standby; the active is SIGKILLed mid-job, the standby is
-    promoted (no new boot in the recovery path) and finishes the job
-    with no dropped tasks."""
+def _run_standby_kill_job(tmp, extra_args=(), kill_after_records=1):
+    """Shared harness for the warm-standby e2e tests: 1 active + 1
+    standby through the real master wiring, SIGKILL the active once
+    `kill_after_records` records completed, return
+    (final_params, final_version, manager) after the job finishes
+    (asserting promotion + no dropped tasks). The model is captured
+    BEFORE teardown — in sharded mode it assembles from the ps_group,
+    which the teardown stops."""
     from elasticdl_tpu.cluster.pod_backend import ProcessBackend
     from elasticdl_tpu.common.args import master_parser, worker_forward_args
     from elasticdl_tpu.master.main import build_master, make_sample_batch_fn
     from elasticdl_tpu.master.worker_manager import WorkerManager
     from elasticdl_tpu.rpc.server import RpcServer
 
-    tmp = str(tmp_path)
     _write_shards(tmp, n_files=2, records_each=64)
     args = master_parser().parse_args(
         [
@@ -210,6 +212,7 @@ def test_standby_promotion_e2e(tmp_path):
             "--num_workers", "1",
             "--num_standby_workers", "1",
             "--worker_backend", "process",
+            *extra_args,
         ]
     )
     spec, dispatcher, servicer, _evs, _ckpt = build_master(args, "training")
@@ -235,7 +238,10 @@ def test_standby_promotion_e2e(tmp_path):
         while not dispatcher.finished():
             assert time.time() < deadline, "job stuck"
             assert not manager.all_exited(), "all workers gone"
-            if not killed and dispatcher.completed_records() > 0:
+            if (
+                not killed
+                and dispatcher.completed_records() >= kill_after_records
+            ):
                 pid = backend.pid_of(0)
                 if pid:
                     os.kill(pid, signal.SIGKILL)
@@ -244,12 +250,37 @@ def test_standby_promotion_e2e(tmp_path):
         assert killed
         assert manager.promotions() == 1
         assert not dispatcher.has_failed_tasks()
-        # the promoted standby (id 1) did the remaining work; the
-        # refill standby (id 2) idled — both must exit cleanly at end
+        params, _aux, version = servicer.get_params_copy()
+        return params, version, manager
     finally:
         manager.stop_relaunch_and_remove_workers()
         backend.stop()
         server.stop()
+        if servicer.ps_group is not None:
+            servicer.ps_group.stop()
+
+
+def test_standby_promotion_e2e(tmp_path):
+    """Warm-standby elasticity with real processes: 1 active + 1
+    pre-warmed standby; the active is SIGKILLed mid-job, the standby is
+    promoted (no new boot in the recovery path) and finishes the job
+    with no dropped tasks."""
+    _run_standby_kill_job(str(tmp_path))
+
+
+def test_standby_with_sharded_ps_e2e(tmp_path):
+    """The two elasticity/scale features compose: a standby pre-warms
+    against the SHARDED PS (slice pulls via GetPSConfig discovery), is
+    promoted on a SIGKILL, and the job converges through the shards."""
+    params, version, _manager = _run_standby_kill_job(
+        str(tmp_path),
+        extra_args=("--num_ps", "2", "--ps_mode", "inproc"),
+        kill_after_records=64,
+    )
+    # the final model assembled from the shards and converged
+    kernel = np.asarray(params["Dense_0"]["kernel"]).ravel()[0]
+    assert abs(kernel - 2.0) < 0.6, kernel
+    assert version > 0
 
 
 def test_job_with_failed_tasks_exits_nonzero(tmp_path):
