@@ -340,6 +340,8 @@ class K8sBackend(PodBackend):
         volume: str = "",
         envs: Optional[Dict[str, str]] = None,
         cluster_spec: str = "",
+        ps_resource_request: str = "",
+        ps_resource_limit: str = "",
     ):
         try:
             from kubernetes import client, config, watch  # noqa: F401
@@ -358,6 +360,21 @@ class K8sBackend(PodBackend):
         self._namespace = namespace
         self._resource_request = resource_request
         self._resource_limit = resource_limit
+        # PS shards pin JAX to CPU (ps_shard_main), so by default they
+        # must NOT inherit the worker's accelerator claim — a TPU per
+        # shard would be wasted and may never schedule
+        from elasticdl_tpu.cluster.k8s_resource import strip_accelerators
+
+        self._ps_resource_request = ps_resource_request or strip_accelerators(
+            resource_request
+        )
+        # an explicit PS request with no PS limit must NOT inherit the
+        # (possibly smaller) worker-derived limit — limits < requests is
+        # an invalid pod spec. Empty limit lets the manifest builder
+        # fall back to limits=requests.
+        self._ps_resource_limit = ps_resource_limit or (
+            "" if ps_resource_request else strip_accelerators(resource_limit)
+        )
         self._pod_priority = pod_priority
         self._volume = volume
         self._envs = envs or {}
@@ -421,8 +438,8 @@ class K8sBackend(PodBackend):
             + list(argv)
             + ["--port", str(port)],
             namespace=self._namespace,
-            resource_request=self._resource_request,
-            resource_limit=self._resource_limit,
+            resource_request=self._ps_resource_request,
+            resource_limit=self._ps_resource_limit,
             volume=self._volume,
             envs=dict(self._envs),
             owner_pod=self._owner(),
@@ -482,7 +499,12 @@ class K8sBackend(PodBackend):
                         break
                     pod = event["object"]
                     labels = pod.metadata.labels or {}
-                    if labels.get(ELASTICDL_REPLICA_TYPE_KEY) != "worker":
+                    # ps shards are watched too: a crashed shard would
+                    # otherwise surface only as every worker's RPCs
+                    # failing (a slow crash-loop) — the event lets the
+                    # WorkerManager fail the job fast instead
+                    rtype = labels.get(ELASTICDL_REPLICA_TYPE_KEY)
+                    if rtype not in ("worker", "ps"):
                         continue
                     wid = int(labels.get(ELASTICDL_REPLICA_INDEX_KEY, -1))
                     if event["type"] == "DELETED":
@@ -497,7 +519,14 @@ class K8sBackend(PodBackend):
                     if phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
                         exit_code = _container_exit_code(pod)
                     if self._cb:
-                        self._cb(PodEvent(wid, phase, exit_code=exit_code))
+                        self._cb(
+                            PodEvent(
+                                wid,
+                                phase,
+                                exit_code=exit_code,
+                                replica_type=rtype,
+                            )
+                        )
                 backoff = 1.0  # clean stream end: reconnect quickly
             except Exception:
                 if not self._stop.is_set():

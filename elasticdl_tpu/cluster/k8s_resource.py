@@ -50,3 +50,23 @@ def parse(resource_str: str) -> Dict[str, str]:
             kl = k  # fully-qualified custom resource, pass through
         out[kl] = v
     return out
+
+
+def strip_accelerators(resource_str: str) -> str:
+    """Drop accelerator entries (aliases and their fully-qualified
+    forms, from _ALIASES — the one source of truth) from a resource
+    string. Used as the default for PS shard pods: the shard process
+    pins JAX to CPU, so inheriting the worker's TPU claim would waste a
+    chip per shard and can make shard pods unschedulable on
+    accelerator-constrained pools."""
+    if not resource_str:
+        return resource_str
+    kept = []
+    for item in resource_str.split(","):
+        if not item.strip():
+            continue
+        k = item.split("=", 1)[0].strip().lower()
+        if k in _ALIASES or k in _ALIASES.values():
+            continue
+        kept.append(item.strip())
+    return ",".join(kept)

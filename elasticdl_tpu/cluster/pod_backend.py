@@ -38,11 +38,12 @@ class PodPhase:
 
 @dataclass
 class PodEvent:
-    """One lifecycle transition of a worker pod/process."""
+    """One lifecycle transition of a worker (or PS shard) pod/process."""
 
     worker_id: int
     phase: str
     exit_code: Optional[int] = None
+    replica_type: str = "worker"
 
 
 class PodBackend:

@@ -153,6 +153,12 @@ def add_master_args(parser: argparse.ArgumentParser):
         help='k8s resource DSL, e.g. "cpu=1,memory=4096Mi,tpu=1"',
     )
     parser.add_argument("--worker_resource_limit", default="")
+    parser.add_argument(
+        "--ps_resource_request", default="",
+        help="k8s resources for PS shard pods (CPU processes); default "
+        "= worker_resource_request with accelerator entries stripped",
+    )
+    parser.add_argument("--ps_resource_limit", default="")
     parser.add_argument("--worker_pod_priority", default="")
     parser.add_argument(
         "--volume", default="",

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 
 from elasticdl_tpu.common.args import (
@@ -293,6 +294,8 @@ def make_backend(args):
         volume=args.volume,
         envs=parse_envs(args.envs),
         cluster_spec=args.cluster_spec,
+        ps_resource_request=getattr(args, "ps_resource_request", ""),
+        ps_resource_limit=getattr(args, "ps_resource_limit", ""),
     )
 
 
@@ -370,6 +373,12 @@ def main(argv=None) -> int:
             servicer.set_sample_batch_fn(
                 make_sample_batch_fn(args.training_data_dir)
             )
+    ps_dead = threading.Event()
+    if servicer.ps_group is not None:
+        # PS shards are job-lifetime with no relaunch path: a dead
+        # shard means every future push/pull fails, so fail the whole
+        # job fast instead of letting the workers crash-loop
+        manager.on_ps_failure = lambda sid: ps_dead.set()
     manager.start_workers()
     logger.info("Worker manager status: %s", WorkerManagerStatus.RUNNING)
 
@@ -378,6 +387,10 @@ def main(argv=None) -> int:
         # reference main loop polls every 30s (main.py:292-300); poll
         # faster here — process workers finish in seconds under test
         while not dispatcher.finished():
+            if ps_dead.is_set():
+                logger.error("a PS shard died: aborting the job")
+                exit_code = 2
+                break
             if manager.all_exited():
                 logger.error(
                     "all workers exited (relaunch budget spent) with "
@@ -400,6 +413,9 @@ def main(argv=None) -> int:
             logger.info("Final model saved to %s", args.output)
     finally:
         logger.info("Worker manager status: %s", WorkerManagerStatus.FINISHED)
+        # disarm BEFORE teardown deletes shard pods: their DELETED
+        # events are expected here, not a mid-job shard death
+        manager.on_ps_failure = None
         manager.stop_relaunch_and_remove_workers()
         ckpt.close()  # queued async checkpoint writes must land
         if eval_service is not None:

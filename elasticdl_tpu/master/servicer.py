@@ -543,7 +543,11 @@ class MasterServicer:
                 ckpt_snapshot = (params, aux)
                 version = max(version, v)
             self._on_version_bump(version, ckpt_snapshot, prev)
-            self._report_train_loss(version, req.get("loss"))
+        # every applied report carries a real loss even when its min
+        # shard version trails the mirror (other workers ran ahead) —
+        # gating on `advanced` would undercount the metrics sink in
+        # sharded mode relative to single-PS, which records every apply
+        self._report_train_loss(max(version, prev), req.get("loss"))
         return resp
 
     def _flat_model(self, model_dtype=None):

@@ -66,6 +66,10 @@ class WorkerManager:
         self._phases: Dict[int, str] = {}
         self._standby: set = set()  # worker ids held in reserve
         self._live = 0
+        # fired when a PS shard pod dies (shards are job-lifetime with
+        # no relaunch machinery — the job must fail fast, not let every
+        # worker crash-loop against a dead endpoint)
+        self.on_ps_failure: Optional[Callable[[int], None]] = None
         backend.set_event_callback(self._event_cb)
 
     # -- lifecycle ----------------------------------------------------------
@@ -115,6 +119,22 @@ class WorkerManager:
     def _event_cb(self, event: PodEvent):
         """Pod phase bookkeeping + recovery
         (reference: k8s_worker_manager.py:110-145)."""
+        if event.replica_type == "ps":
+            # shards are job-lifetime services: ANY terminal phase seen
+            # while the callback is armed (incl. SUCCEEDED — an exit-0
+            # shard is just as dead an endpoint) means the job must
+            # abort fast. Teardown disarms the callback before deleting
+            # the shard pods, so clean-shutdown DELETED events are quiet.
+            if event.phase in _TERMINAL:
+                cb = self.on_ps_failure
+                if cb is not None:
+                    logger.error(
+                        "PS shard pod %d %s: failing the job",
+                        event.worker_id,
+                        event.phase,
+                    )
+                    cb(event.worker_id)
+            return
         done = event.phase in _TERMINAL
         # "completed with dropped poison tasks": a deliberate terminal
         # state — relaunching would just exit 2 again, churning the

@@ -9,9 +9,11 @@ whole point of sharding the PS; SURVEY §7.3 item 3). Slices follow
 
 from __future__ import annotations
 
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
+import grpc
 import numpy as np
 
 from elasticdl_tpu.master.ps_shard import slice_boundaries
@@ -37,13 +39,45 @@ class ShardedPS:
         return len(self.endpoints)
 
     def wait_ready(self, timeout: float = 30.0):
-        self._map(lambda c, i: c.wait_ready(timeout))
+        self._map(lambda c, i: c.wait_ready(timeout), idempotent=True)
 
-    def _map(self, fn):
+    def _map(self, fn, idempotent: bool = False):
         """fn(client, shard_index) on every shard concurrently; returns
-        results in shard order, re-raising the first failure."""
+        results in shard order, re-raising the first failure.
+
+        Failure model — TORN REPORTS. Shards apply their slices
+        independently; there is no cross-shard transaction, so when one
+        shard's RPC fails after the others applied theirs, the report is
+        torn: some slices saw it, the failed slice never will. The
+        caller (worker) responds by resetting local state and
+        re-training the covered tasks, so no *work* is lost, but the
+        applied slices' version histories run ahead by one report —
+        permanent exactness across slices would need 2PC, which this
+        plane deliberately omits (ps_shard.py design note). Retries
+        narrow the transient-blip window but only for IDEMPOTENT ops
+        (pull, wait_ready, SETNX init): gRPC can surface UNAVAILABLE
+        *after* the server processed a request (connection reset before
+        the response lands), so resending a push_grad/push_delta could
+        silently double-apply a slice — strictly worse than the torn
+        report, which at least surfaces to the caller's reset path."""
+
+        def with_retry(c, i):
+            for attempt in range(3):
+                try:
+                    return fn(c, i)
+                except grpc.RpcError as e:  # pragma: no cover - timing
+                    code = getattr(e, "code", lambda: None)()
+                    if (
+                        not idempotent
+                        or code is not grpc.StatusCode.UNAVAILABLE
+                        or attempt == 2
+                    ):
+                        raise
+                    time.sleep(0.1 * (attempt + 1))
+
         futs = [
-            self._pool.submit(fn, c, i) for i, c in enumerate(self._clients)
+            self._pool.submit(with_retry, c, i)
+            for i, c in enumerate(self._clients)
         ]
         return [f.result() for f in futs]
 
@@ -61,7 +95,8 @@ class ShardedPS:
                 "PSInit", {"vec": vec[s:e], "version": version}
             )["version"]
 
-        return self._map(do)
+        # SETNX semantics on the shard make a resend a no-op
+        return self._map(do, idempotent=True)
 
     def pull(
         self,
@@ -85,7 +120,7 @@ class ShardedPS:
                 req["model_dtype"] = model_dtype
             return c.call("PSPull", req)
 
-        resps = self._map(do)
+        resps = self._map(do, idempotent=True)  # read-only
         new_versions = [r["version"] for r in resps]
         if any(v < 0 for v in new_versions):
             return new_versions, None

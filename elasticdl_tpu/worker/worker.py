@@ -347,6 +347,10 @@ class Worker:
                 self._shard_versions = versions
             resp = {"accepted": True, "version": min(versions)}
             if vec is not None:
+                # no aux round-trip with the piggybacked model: aux is
+                # last-writer-wins and THIS report just wrote aux_h to
+                # the mirror, so the local aux already matches it — the
+                # same post-apply state a single-PS response would echo
                 resp["params_flat"] = vec
             return resp, loss_h
         req = {
@@ -1003,6 +1007,11 @@ class Worker:
             self._sync_epoch += 1
             self._fresh = False
             self._version = -1
+            # the sharded-PS pull keys only_if_newer off the per-shard
+            # vector, not self._version — it must be dropped too or a
+            # post-failure pull on an unadvanced PS returns vec=None and
+            # the diverged local params survive the reset
+            self._shard_versions = None
             self._sync_result = None
             self._base_snapshots.clear()
         self._opt_state = None

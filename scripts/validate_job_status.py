@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Job-level CI gate: poll a submitted job's pods until the job
+resolves, then exit 0 (success) or 1 (failure/timeout).
+
+Re-design of the reference's `scripts/validate_job_status.sh:14-48`
+(fixed two-worker kubectl loop) over this framework's label schema:
+instead of polling hard-coded pod names, select every pod of the job by
+the `elasticdl-job-name` label, so elastically relaunched workers
+(fresh ids), standbys, and PS shards are all observed.
+
+Success   = master pod Succeeded (the master's exit code IS the job
+            verdict: it already accounts for dropped tasks, dead PS
+            shards, spent relaunch budgets — master/main.py).
+Failure   = master pod Failed, or timeout.
+On failure the master's log tail is printed for the CI transcript, and
+the master pod is deleted (ownerReferences GC the worker/PS pods).
+
+Usage: validate_job_status.py <job_name> [--namespace ns]
+           [--timeout 2000] [--interval 10] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("job_name")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--timeout", type=float, default=2000.0)
+    ap.add_argument("--interval", type=float, default=10.0)
+    ap.add_argument(
+        "--keep", action="store_true",
+        help="do not delete the master pod after the verdict",
+    )
+    args = ap.parse_args(argv)
+
+    from kubernetes import client, config
+
+    from elasticdl_tpu.cluster.k8s_backend import (
+        ELASTICDL_JOB_KEY,
+        ELASTICDL_REPLICA_TYPE_KEY,
+        master_pod_name,
+    )
+
+    try:
+        config.load_incluster_config()
+    except Exception:
+        config.load_kube_config()
+    core = client.CoreV1Api()
+    selector = f"{ELASTICDL_JOB_KEY}={args.job_name}"
+    master = master_pod_name(args.job_name)
+
+    def finish(ok: bool) -> int:
+        if not ok:
+            try:
+                log = core.read_namespaced_pod_log(
+                    master, args.namespace, tail_lines=50
+                )
+                print(f"--- master log tail ---\n{log}", file=sys.stderr)
+            except Exception as e:
+                print(f"(master log unavailable: {e})", file=sys.stderr)
+        if not args.keep:
+            try:
+                core.delete_namespaced_pod(master, args.namespace)
+            except Exception:
+                pass
+        return 0 if ok else 1
+
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        pods = core.list_namespaced_pod(
+            args.namespace, label_selector=selector
+        ).items
+        phases = {}
+        for p in pods:
+            rtype = (p.metadata.labels or {}).get(
+                ELASTICDL_REPLICA_TYPE_KEY, "?"
+            )
+            phases[f"{rtype}/{p.metadata.name}"] = (
+                p.status.phase if p.status else "?"
+            )
+        mphase = next(
+            (ph for k, ph in phases.items() if k.startswith("master/")), None
+        )
+        if mphase == "Succeeded":
+            print(f"job {args.job_name} succeeded: {phases}")
+            return finish(True)
+        if mphase == "Failed":
+            print(f"job {args.job_name} FAILED: {phases}", file=sys.stderr)
+            return finish(False)
+        print(f"waiting... {phases or 'no pods yet'}")
+        time.sleep(args.interval)
+    print(f"job {args.job_name} timed out", file=sys.stderr)
+    return finish(False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

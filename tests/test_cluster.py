@@ -272,3 +272,46 @@ def test_stop_relaunch_suppresses_replacement():
     # deletes fired DELETED events; nothing relaunched
     assert len(backend.started) == 2
     assert manager.all_exited()
+
+
+# -- PS shard pod handling (ADVICE r3) --------------------------------------
+
+
+def test_strip_accelerators():
+    assert (
+        k8s_resource.strip_accelerators("cpu=2,memory=4Gi,tpu=8")
+        == "cpu=2,memory=4Gi"
+    )
+    assert (
+        k8s_resource.strip_accelerators("google.com/tpu=8,cpu=1") == "cpu=1"
+    )
+    assert (
+        k8s_resource.strip_accelerators("nvidia.com/gpu=2,gpu=1,memory=1Gi")
+        == "memory=1Gi"
+    )
+    assert k8s_resource.strip_accelerators("") == ""
+    assert k8s_resource.strip_accelerators("cpu=1") == "cpu=1"
+
+
+def test_ps_shard_failure_fails_job_fast():
+    """A dead PS shard (no relaunch machinery) must surface through the
+    manager's on_ps_failure hook, not count against worker bookkeeping."""
+    manager, backend, _ = _manager(num_workers=2)
+    manager.start_workers()
+    failed = []
+    manager.on_ps_failure = failed.append
+    backend._cb(PodEvent(1, PodPhase.FAILED, replica_type="ps"))
+    assert failed == [1]
+    # worker accounting untouched: no relaunch, live count intact
+    assert manager.live_workers() == 2
+    assert len(backend.started) == 2
+    # a RUNNING ps event is a no-op
+    backend._cb(PodEvent(0, PodPhase.RUNNING, replica_type="ps"))
+    assert failed == [1]
+    # an exit-0 shard is just as dead an endpoint
+    backend._cb(PodEvent(2, PodPhase.SUCCEEDED, replica_type="ps"))
+    assert failed == [1, 2]
+    # disarmed (teardown): further terminal ps events are quiet
+    manager.on_ps_failure = None
+    backend._cb(PodEvent(0, PodPhase.DELETED, replica_type="ps"))
+    assert failed == [1, 2]

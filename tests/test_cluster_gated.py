@@ -24,6 +24,8 @@ import uuid
 
 import pytest
 
+pytestmark = pytest.mark.gated
+
 K8S = os.environ.get("K8S_TESTS") == "1"
 TPU = os.environ.get("EDL_TPU_TESTS") == "1"
 

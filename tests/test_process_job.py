@@ -16,6 +16,8 @@ import pytest
 from elasticdl_tpu.master.main import collect_shards, main as master_main
 from elasticdl_tpu.testing import write_linear_records
 
+pytestmark = pytest.mark.e2e
+
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
 

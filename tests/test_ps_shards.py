@@ -391,3 +391,28 @@ def test_process_mode_shard_group(tmp_path):
         np.testing.assert_allclose(got, vec + 1.0)
     finally:
         group.stop()
+
+
+def test_reset_local_state_clears_shard_versions():
+    """ADVICE r3 (high): after a failed sync the sharded pull must be
+    unconditional — a surviving per-shard version vector would let
+    only_if_newer return no payload and the diverged local params
+    outlive the reset."""
+    import threading
+
+    w = Worker.__new__(Worker)
+    w._report_lock = threading.Lock()
+    w._sync_epoch = 0
+    w._fresh = True
+    w._version = 7
+    w._shard_versions = [7, 7, 7]
+    w._sync_result = (1, None, None)
+    w._base_snapshots = {1: None}
+    w._opt_state = object()
+    w._pending_steps = 3
+    w._pending_losses = [0.1]
+    w._reset_local_state()
+    assert w._shard_versions is None
+    assert w._version == -1
+    assert not w._fresh
+    assert w._sync_result is None and not w._base_snapshots
