@@ -1,0 +1,247 @@
+"""ResNet-50 — the north-star benchmark (BASELINE.md: "ResNet-50
+images/sec/chip").
+
+Reference model: model_zoo/resnet50_subclass/resnet50_subclass.py:1-221
+(rebuilt TPU-first in elasticdl_tpu/models/resnet50_subclass.py). The
+reference never published a ResNet number; BASELINE.md's north star is
+throughput per chip, so this bench measures it TWO ways and prints ONE
+JSON line:
+
+1. **chip** (headline, images/sec/chip): the full train step — fwd,
+   bwd, SGD-momentum + weight decay, BN stat update — scanned K steps
+   back-to-back with DEVICE-RESIDENT data, bf16 compute / f32 params.
+   This is the number a co-located TPU-VM worker reaches, where input
+   batches ride PCIe (GB/s) instead of this host's tunnel. MFU comes
+   from XLA's own cost analysis of the compiled step (scan body counted
+   once; multiplied by the trip count).
+
+2. **runtime** (elastic number): the same model trained end-to-end
+   through the elastic PS runtime — real gRPC master, RecordIO shards,
+   window mode with chained delta syncs, BN aux riding the sync, bf16
+   transport — at 64x64 input, convergence-gated.
+
+Physics of the gap (measured, not asserted — the JSON carries the
+link bandwidth): ResNet-50 consumes ~80 KFLOP per uint8 input byte,
+so feeding the chip's ~197 bf16 TFLOP/s needs ~2.5 GB/s of input.
+This host reaches the chip through a ~90 ms tunnel measured at tens
+of MB/s — the elastic-runtime number is input-bandwidth-bound here by
+three orders of magnitude, NOT runtime-bound. The phase breakdown in
+the runtime protocol shows the runtime's own overhead (task dispatch,
+sync scheduling) stays in the noise; on a TPU-VM the identical job is
+compute-bound at the chip number. CIFAR-10 (bench.py) does not hit
+this wall only because its images are 12x smaller per FLOP.
+"""
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+
+def measure_link_bandwidth(nbytes=32 * 1024 * 1024, reps=3):
+    """Sustained h2d bandwidth of the host<->device link (MB/s)."""
+    import jax
+    import numpy as np
+
+    buf = np.random.default_rng(0).integers(
+        0, 255, size=nbytes, dtype=np.uint8
+    )
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.time()
+        jax.device_put(buf).block_until_ready()
+        best = max(best, nbytes / (time.time() - t0))
+    return best / 1e6
+
+
+def chip_throughput(res=224, batch=64, steps=16, reps=4, num_classes=1000):
+    """Device-resident scanned train steps -> (imgs/sec, mfu, loss0)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from elasticdl_tpu.models import resnet50_subclass as m
+
+    model = m.custom_model(num_classes=num_classes, bfloat16=True)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.randint(
+        rng, (batch, res, res, 3), 0, 255, dtype=jnp.int32
+    ).astype(jnp.uint8)
+    labels = jax.random.randint(rng, (batch,), 0, num_classes, jnp.int32)
+    variables = model.init(rng, images, train=True)
+    params, aux = variables["params"], variables["batch_stats"]
+    tx = m.optimizer()
+    opt_state = tx.init(params)
+
+    def one_step(carry, _):
+        params, aux, opt_state = carry
+
+        def loss_fn(p):
+            out, new_vars = model.apply(
+                {"params": p, "batch_stats": aux},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return m.loss(out, labels), new_vars["batch_stats"]
+
+        (l, new_aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_aux, opt_state), l
+
+    def k_steps(params, aux, opt_state):
+        return lax.scan(one_step, (params, aux, opt_state), None, length=steps)
+
+    lowered = jax.jit(k_steps).lower(params, aux, opt_state)
+    compiled = lowered.compile()
+    # XLA counts the scan body ONCE regardless of trip count
+    body_flops = compiled.cost_analysis()["flops"]
+    state = (params, aux, opt_state)
+    state, losses = compiled(*state)  # warm-up execution
+    jax.block_until_ready(state)
+    loss0 = float(losses[0])
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.time()
+        state, losses = compiled(*state)
+        jax.block_until_ready(losses)
+        dt = time.time() - t0
+        best = max(best, steps * batch / dt)
+    tflops = body_flops * (best / batch) / 1e12  # flops/step * steps/sec
+    return best, tflops, tflops / 197.0, loss0
+
+
+def runtime_throughput(window=32, minibatch=128, n_records=16384):
+    """ResNet-50 through the elastic PS runtime (window mode, bf16
+    transport, BN aux riding the sync) on synthetic 64x64 RecordIO."""
+    from bench import run_job
+
+    from elasticdl_tpu.models import resnet50_subclass as model_module
+    from elasticdl_tpu.models.record_codec import (
+        write_synthetic_image_records,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="edl_bench_resnet_")
+    path = os.path.join(tmp, "imgs.rio")
+    write_synthetic_image_records(
+        path, n_records, model_module.IMAGE_SHAPE, model_module.NUM_CLASSES
+    )
+    os.environ["EDL_BENCH_MFU"] = "1"
+    imgs_per_sec, worker, elapsed = run_job(
+        model_module,
+        path,
+        n_records,
+        minibatch=minibatch,
+        records_per_task=window * minibatch,
+        epochs=1,
+        local_updates=window,
+        grads_to_wait=1,
+        transport_dtype="bfloat16",
+        spec_overrides={"model": model_module.custom_model(bfloat16=True)},
+    )
+    losses = worker.task_losses
+    tail = statistics.median(losses[-3:]) if losses else None
+    mfu = None
+    if getattr(worker, "window_flops", None):
+        per_image = worker.window_flops / (window * minibatch)
+        mfu = per_image * imgs_per_sec / 1e12 / 197.0
+    print(
+        f"bench_resnet[runtime]: {n_records} imgs in {elapsed:.1f}s = "
+        f"{imgs_per_sec:.1f} img/s; tail loss {tail}; "
+        f"phases {worker.timers.summary()}",
+        file=sys.stderr,
+    )
+    return imgs_per_sec, mfu, tail
+
+
+def main():
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() == "tpu"
+
+    link_mbps = measure_link_bandwidth() if on_tpu else None
+    if on_tpu:
+        res, batch, steps = 224, 64, 16
+    else:  # CPU smoke: tiny everything
+        res, batch, steps = 64, 8, 2
+    chip_ips, chip_tflops, chip_mfu, chip_loss = chip_throughput(
+        res=res, batch=batch, steps=steps, reps=4 if on_tpu else 1
+    )
+    print(
+        f"bench_resnet[chip]: {res}x{res} b{batch}: {chip_ips:.1f} img/s = "
+        f"{chip_tflops:.1f} TFLOP/s = {100 * chip_mfu:.1f}% MFU(v5e); "
+        f"first loss {chip_loss:.2f}",
+        file=sys.stderr,
+    )
+    chip64_ips = chip64_mfu = None
+    if on_tpu:
+        chip64_ips, _t, chip64_mfu, _l = chip_throughput(
+            res=64, batch=256, steps=32, reps=4, num_classes=10
+        )
+        print(
+            f"bench_resnet[chip64]: {chip64_ips:.1f} img/s = "
+            f"{100 * chip64_mfu:.1f}% MFU",
+            file=sys.stderr,
+        )
+
+    rt_ips, rt_mfu, rt_tail = runtime_throughput(
+        window=32 if on_tpu else 2,
+        minibatch=128 if on_tpu else 16,
+        n_records=16384 if on_tpu else 64,
+    )
+    if on_tpu and rt_tail is not None:
+        assert rt_tail < 2.0, f"runtime run diverged: tail {rt_tail:.3f}"
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_chip",
+                "value": round(chip_ips, 1),
+                "unit": "images/sec/chip",
+                "resolution": res,
+                "chip_tflops_per_sec": round(chip_tflops, 2),
+                "chip_mfu_vs_v5e_bf16_peak": round(chip_mfu, 4),
+                "chip_64px_images_per_sec": (
+                    round(chip64_ips, 1) if chip64_ips else None
+                ),
+                "chip_64px_mfu": (
+                    round(chip64_mfu, 4) if chip64_mfu else None
+                ),
+                "runtime_images_per_sec_64px": round(rt_ips, 1),
+                "runtime_mfu": round(rt_mfu, 4) if rt_mfu else None,
+                "runtime_tail_loss": (
+                    round(rt_tail, 4) if rt_tail is not None else None
+                ),
+                "link_bandwidth_MBps": (
+                    round(link_mbps, 1) if link_mbps else None
+                ),
+                "protocol": (
+                    "chip = full train step (fwd+bwd+SGD-momentum+WD+BN "
+                    "update), bf16 compute/f32 params, device-resident "
+                    "data, K-step lax.scan, best of 4 timed reps after "
+                    "an untimed compile+warm-up; MFU from XLA "
+                    "cost_analysis of the scan body x trip count / 197 "
+                    "TFLOP/s. runtime = the same model end-to-end "
+                    "through the elastic PS runtime (gRPC master, "
+                    "RecordIO, 32-step windows, chained syncs, bf16 "
+                    "wire), convergence-gated. The runtime number on "
+                    "THIS host is input-bound by the tunnel "
+                    "(link_bandwidth_MBps measured above; ResNet needs "
+                    "~2.5 GB/s to saturate the chip) — on a co-located "
+                    "TPU-VM the same runtime is compute-bound at the "
+                    "chip number"
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,15 @@ def add_master_args(parser: argparse.ArgumentParser):
     parser.add_argument("--lr_staleness_modulation", action="store_true")
     parser.add_argument("--staleness_window", type=non_neg_int, default=0)
     parser.add_argument(
+        "--step_pipeline", type=int, default=-1,
+        help="per-step pipeline DEPTH: up to N gradient reports in "
+        "flight while later batches compute, so the report round's "
+        "latency is divided across N batches (each report may land up "
+        "to N versions stale). 0=off; -1=auto (4, clamped to "
+        "--staleness_window in sync mode; async mode accepts any "
+        "depth and down-weights by staleness)",
+    )
+    parser.add_argument(
         "--num_ps", type=non_neg_int, default=0,
         help="N>0: shard the dense model across N parameter-server "
         "endpoints (workers push/pull slices in parallel); 0: the "
@@ -175,6 +184,27 @@ def add_worker_args(parser: argparse.ArgumentParser):
     """Worker-process flags (reference: worker/main.py:10-83)."""
     parser.add_argument("--worker_id", type=non_neg_int, required=True)
     parser.add_argument("--master_addr", required=True)
+    # already resolved by the master (resolve_step_pipeline): the
+    # worker itself doesn't know the PS staleness policy
+    parser.add_argument("--step_pipeline", type=non_neg_int, default=0)
+
+
+def resolve_step_pipeline(args) -> int:
+    """Resolve the per-step pipeline DEPTH (in-flight gradient
+    reports). Legality: a report may be up to `depth` versions stale
+    when it lands, so sync mode clamps the depth to --staleness_window
+    (anything deeper would just bounce off the rejection path); async
+    mode accepts any staleness (down-weighted), so the requested depth
+    stands. Auto (-1) picks 4 — enough to cover a high-latency link's
+    report round with compute at typical step times — capped by the
+    window. Window mode (local_updates) has its own chained-sync
+    pipeline and keeps per-step off."""
+    if args.local_updates:
+        return 0
+    depth = 4 if args.step_pipeline < 0 else args.step_pipeline
+    if not args.use_async:
+        depth = min(depth, args.staleness_window)
+    return depth
 
 
 def master_parser() -> argparse.ArgumentParser:
@@ -363,6 +393,7 @@ def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:
         "--minibatch_size", str(args.minibatch_size),
         "--local_updates", str(args.local_updates),
         "--transport_dtype", args.transport_dtype,
+        "--step_pipeline", str(resolve_step_pipeline(args)),
         "--log_level", args.log_level,
     ]
     for flag in (

@@ -68,6 +68,7 @@ def main(argv=None) -> int:
         local_updates=args.local_updates,
         transport_dtype=args.transport_dtype,
         ps_endpoints=ps_endpoints,
+        step_pipeline=args.step_pipeline,
     )
     # device-level tracing (SURVEY §5.1): a jax.profiler trace of the
     # whole task loop, viewable in TensorBoard/Perfetto/XProf. The

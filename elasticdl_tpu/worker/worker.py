@@ -79,6 +79,7 @@ class Worker:
         local_updates: int = 0,
         seed: int = 0,
         ps_endpoints=None,  # sharded PS (master/ps_shard.py) fan-out
+        step_pipeline: int = 0,
     ):
         self._id = worker_id
         self._master = master
@@ -152,6 +153,24 @@ class Worker:
         self._sync_result = None  # (seq, params_flat, aux) piggyback
         self._base_snapshots: Dict[int, Any] = {}  # seq -> base at spawn
         self._sync_error = None  # exception raised by the async push
+        # Per-step pipelining (sync-SGD latency hiding): with
+        # `step_pipeline` = k > 0, up to k gradient reports ride the
+        # link on background threads while later batches compute on the
+        # device. Wall per step drops from compute+RPC to
+        # max(compute, RPC/k): on a high-latency link the report round
+        # itself dominates (not compute), so OVERLAPPING THE REPORTS
+        # WITH EACH OTHER is where the win is — depth 1 only hides
+        # compute. Protocol-legal whenever the PS accepts k-stale
+        # gradients (staleness_window >= k, or async mode which
+        # down-weights by staleness; the master resolves the legal
+        # depth and forwards it — common/args.resolve_step_pipeline).
+        # Every report carries its COMPUTE-time version, so the PS's
+        # staleness accounting stays honest, and responses absorb
+        # through a monotonic version guard (_absorb_report_response)
+        # because concurrent unary RPCs can complete out of order.
+        self._step_pipeline = max(0, int(step_pipeline))
+        self._step_inflight: "deque" = deque()  # (thread, box, f, l)
+        self._last_step_loss = None  # newest resolved pipelined loss
         self._pending_losses: list = []  # (task_id|None, device scalar)
         self._latest_step_loss = None  # device scalar of the newest step
         self._deferred_reports: list = []  # task results gated on sync
@@ -309,15 +328,30 @@ class Worker:
         )
 
     def report_gradient(
-        self, grads, edl_grads, aux_state, flat: bool = False, loss=None
+        self,
+        grads,
+        edl_grads,
+        aux_state,
+        flat: bool = False,
+        loss=None,
+        version=None,
+        shard_base=None,
     ):
         """Returns (response, loss_value). ONE batched d2h round
         (device_get) moves gradient + aux + loss together — per-item
         np.asarray costs a full round-trip each over a high-latency
-        device link."""
+        device link.
+
+        `version` / `shard_base` override the live counters with the
+        values captured at COMPUTE time — the pipelined path absorbs a
+        newer model between compute and send, and reporting the newer
+        version for an older gradient would corrupt the PS's staleness
+        accounting."""
         grads_h, aux_h, loss_h = jax.device_get(
             (grads, aux_state or None, loss)
         )
+        if version is None:
+            version = self._version
         if flat and self._ensure_ps() is not None:
             # sharded PS per-step path (async/windowed-sync shards —
             # strict-equality sync is refused at master boot): gradient
@@ -328,10 +362,13 @@ class Worker:
             model_dtype = (
                 "bfloat16" if self._transport_dtype == "bfloat16" else None
             )
-            with self._report_lock:
-                base = self._shard_versions or [
-                    self._version
-                ] * self._ps.num_shards
+            if shard_base is not None:
+                base = shard_base
+            else:
+                with self._report_lock:
+                    base = self._shard_versions or [
+                        version
+                    ] * self._ps.num_shards
             versions, vec = self._ps.push_grad(
                 grads_h, base, model_dtype=model_dtype, return_model=True
             )
@@ -344,7 +381,16 @@ class Worker:
                 meta["loss"] = float(loss_h)
             self._master.call("ReportWindowMeta", meta)
             with self._report_lock:
-                self._shard_versions = versions
+                # elementwise max: concurrent pipelined pushes can
+                # complete out of order, and a rolled-back vector would
+                # overstate the next push's staleness and defeat the
+                # only_if_newer pull optimisation
+                cur = self._shard_versions
+                self._shard_versions = (
+                    list(versions)
+                    if cur is None
+                    else [max(a, b) for a, b in zip(cur, versions)]
+                )
             resp = {"accepted": True, "version": min(versions)}
             if vec is not None:
                 # no aux round-trip with the piggybacked model: aux is
@@ -355,7 +401,7 @@ class Worker:
             return resp, loss_h
         req = {
             "worker_id": self._id,
-            "version": self._version,
+            "version": version,
             "edl_gradient": edl_grads or None,
             "aux_state": aux_h,
         }
@@ -1111,28 +1157,33 @@ class Worker:
                 },
             )
 
+    def _ensure_step_ready(self, features, task: Task):
+        """Shared per-step preamble: model freshness (pull, or the lazy
+        PS init handshake when the master is uninitialized — reference
+        worker.py:278-282, servicer.py:299-303), then the step build
+        (after the first pull/init so the flat-transport template is
+        known). Used by both the serial retry loop and the pipelined
+        path — the handshake must never fork."""
+        if not self._fresh or self._version < task.model_version:
+            with self.timers.phase("get_model"):
+                pulled = self.pull_model(
+                    max(self._version, task.model_version)
+                )
+            if not pulled:
+                embs = self._prepare_embeddings(features)
+                self._init_model(features, self._dev_embedding_inputs(embs))
+                self.report_variable()
+                self.pull_model()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+            self._eval_step = self._build_eval_step()
+
     def _process_minibatch(self, features, labels, task: Task) -> float:
         """Sync-SGD retry loop (reference: worker.py:347-388). With flat
         transport the steady state is ONE ReportGradient per minibatch:
         the response piggybacks the updated model, so no separate pull."""
         for _ in range(MAX_MINIBATCH_RETRY_NUM):
-            if not self._fresh or self._version < task.model_version:
-                with self.timers.phase("get_model"):
-                    pulled = self.pull_model(
-                        max(self._version, task.model_version)
-                    )
-                if not pulled:
-                    # master uninitialized: init from our side (lazy PS
-                    # init, reference worker.py:278-282, servicer.py:299-303)
-                    embs = self._prepare_embeddings(features)
-                    self._init_model(features, self._dev_embedding_inputs(embs))
-                    self.report_variable()
-                    self.pull_model()
-            if self._train_step is None:
-                # built after the first pull/init so the flat-transport
-                # template is known
-                self._train_step = self._build_train_step()
-                self._eval_step = self._build_eval_step()
+            self._ensure_step_ready(features, task)
             embs = self._prepare_embeddings(features)
             step = self._train_step
             if not self._divisible(features):
@@ -1158,16 +1209,131 @@ class Worker:
                 return float(loss_h)
         raise RuntimeError("worker stuck: minibatch retries exhausted")
 
+    # ------------------------------------------- pipelined per-step sync
+
+    def _step_pipeline_on(self) -> bool:
+        return bool(
+            self._step_pipeline
+            and self._use_flat()
+            and not self._emb_specs
+            and not self._local_updates
+        )
+
+    def _pipelined_minibatch(self, features, labels, task: Task):
+        """Depth-k pipelined sync-SGD: dispatch this batch's
+        forward/backward on the device, launch its gradient report on a
+        background thread, and only block when k reports are already in
+        flight (reference protocol: servicer.py:169-229; the per-step
+        analog of the chained window syncs above).
+
+        On a high-latency link the report round dominates wall clock
+        (~95% in the phase breakdown), so k reports in flight divide
+        the round's latency across k batches — the same reasoning as
+        `_max_inflight_syncs` for windows. Each gradient is computed up
+        to k reports behind the version it lands on — exactly the
+        staleness the PS accepts and down-weights under
+        `staleness_window >= k` / async mode. The compute-time version
+        rides each report so that accounting stays honest; a rejection
+        (staleness outran the window — other workers advanced) falls
+        back to the serial retry loop for that batch at the join."""
+        if not self._fresh or self._version < task.model_version:
+            # drain first: an in-flight response may carry the refresh
+            self._join_step_pipeline(task)
+        self._ensure_step_ready(features, task)
+        embs = self._prepare_embeddings(features)
+        step = self._train_step
+        if not self._divisible(features):
+            step = self._ragged_train_step()
+        loss, gparams, _gbets, new_aux = step(
+            self._step_params(), self._aux, embs, features, labels
+        )
+        compute_version = self._version
+        with self._report_lock:
+            shard_base = (
+                list(self._shard_versions) if self._shard_versions else None
+            )
+        box: dict = {}
+
+        def report_main():
+            try:
+                box["resp"], box["loss"] = self.report_gradient(
+                    gparams,
+                    None,
+                    new_aux,
+                    flat=True,
+                    loss=loss,
+                    version=compute_version,
+                    shard_base=shard_base,
+                )
+            except Exception as e:  # re-raised at the next join
+                box["err"] = e
+
+        t = threading.Thread(target=report_main, daemon=True)
+        self._step_inflight.append((t, box, features, labels))
+        t.start()
+        # backpressure: bound in-flight reports at the pipeline depth
+        while len(self._step_inflight) > self._step_pipeline:
+            self._join_one_step(task)
+
+    def _join_one_step(self, task: Task):
+        """Join the OLDEST in-flight step report, absorb its
+        piggybacked model on THIS thread (device ops stay off the
+        reporter threads), and serially re-train the batch if the PS
+        rejected its staleness. FIFO joins + the monotonic absorb
+        guard make out-of-order RPC completions harmless."""
+        t, box, features, labels = self._step_inflight.popleft()
+        try:
+            with self.timers.phase("sync_wait"):
+                t.join()
+            if "err" in box:
+                raise box["err"]
+            resp = box["resp"]
+            self._absorb_report_response(resp)
+            if box.get("loss") is not None:
+                self._last_step_loss = float(box["loss"])
+            if not resp.get("accepted", True):
+                # staleness outran the window: recompute at a fresh
+                # model. The serial loop re-pulls, recomputes, and
+                # retries — guaranteed forward progress before the
+                # next dispatch.
+                self._last_step_loss = self._process_minibatch(
+                    features, labels, task
+                )
+        except Exception:
+            # the task is about to fail and be requeued wholesale:
+            # younger in-flight entries must not leak into the NEXT
+            # task's drain (their boxed errors/rejections would fail a
+            # healthy task). Join them so no reporter thread outlives
+            # its batch buffers, then discard.
+            for lt, _lb, _f, _l in self._step_inflight:
+                lt.join()
+            self._step_inflight.clear()
+            raise
+
+    def _join_step_pipeline(self, task: Task):
+        """Drain every in-flight step report."""
+        while self._step_inflight:
+            self._join_one_step(task)
+
     def _absorb_report_response(self, resp):
-        """Track freshness + absorb a piggybacked model."""
-        if resp.get("params_flat") is not None and self._use_flat():
+        """Track freshness + absorb a piggybacked model. Monotonic:
+        a response whose version is BEHIND the local model (possible
+        with pipelined reports completing out of order) must not roll
+        the local params back."""
+        v = resp["version"]
+        if (
+            resp.get("params_flat") is not None
+            and self._use_flat()
+            and v > self._version
+        ):
             self._set_flat(resp["params_flat"], resp.get("aux"))
-            self._version = resp["version"]
+            self._version = v
             self._fresh = True
-        elif resp["version"] == self._version:
+        elif v == self._version:
             self._fresh = True  # nothing applied yet; still current
-        else:
-            self._fresh = False
+        elif v > self._version:
+            self._fresh = False  # master ran ahead without a piggyback
+        # v < self._version: late out-of-order response; local is newer
 
     def _ragged_train_step(self):
         """Uncached single-device fallback for batches not divisible by
@@ -1202,17 +1368,29 @@ class Worker:
             loss = self._run_local_windows(batches, task)
         else:
             loss = None
+            batches_ran = 0
             while True:
                 with self.timers.phase("get_batch"):
                     batch = next(batches, None)
                 if batch is None:
                     break
                 features, labels = batch
+                batches_ran += 1
                 with self.timers.phase("compute"):
                     if self._local_updates:
                         loss = self._local_minibatch(features, labels, task)
+                    elif self._step_pipeline_on():
+                        self._pipelined_minibatch(features, labels, task)
                     else:
                         loss = self._process_minibatch(features, labels, task)
+            if self._step_pipeline_on():
+                # drain before the task result: elastically correct only
+                # if every gradient of this task reached the PS first
+                self._join_step_pipeline(task)
+                # a zero-batch task resolves no loss of its own; leave
+                # `loss` None rather than echoing a previous task's
+                if batches_ran:
+                    loss = self._last_step_loss
         deferred = False
         if self._local_updates:
             # Loss resolution + the completion log ride a sync thread's
@@ -1228,7 +1406,7 @@ class Worker:
             self._defer_report(task.task_id, "")
             deferred = True
             self._sync_local_updates(blocking=False)  # push any ragged tail
-        else:
+        elif loss is not None:  # a zero-batch task has no loss
             # resolving the loss blocks on the dispatched steps; timing
             # it keeps the phase breakdown summing to wall clock
             with self.timers.phase("device_wait"):

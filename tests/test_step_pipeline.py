@@ -1,0 +1,193 @@
+"""Per-step pipelined sync-SGD (latency hiding): batch N's gradient
+report rides a background thread while batch N+1 computes.
+
+The pipeline is protocol-legal under `staleness_window >= 1` (the PS
+down-weights one-stale gradients, servicer.py report path) or async
+mode; these tests drive the real Worker against the real servicer in
+process (the reference's worker_test.py pattern) plus the sharded-PS
+composition.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.common.args import master_parser, resolve_step_pipeline
+from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.testing import InProcessMaster, write_linear_records
+from elasticdl_tpu.worker.worker import Worker
+
+from tests.fixtures import linear_module
+
+
+def make_job(
+    tmp_path,
+    n_records=64,
+    records_per_task=16,
+    epochs=2,
+    grads_to_wait=1,
+    staleness_window=1,
+    use_async=False,
+):
+    path = str(tmp_path / "train.rio")
+    write_linear_records(path, n_records, noise=0.05)
+    dispatcher = TaskDispatcher(
+        {path: n_records}, {}, {}, records_per_task, epochs
+    )
+    servicer = MasterServicer(
+        grads_to_wait=grads_to_wait,
+        optimizer=PSOptimizer(linear_module.optimizer()),
+        task_dispatcher=dispatcher,
+        staleness_window=staleness_window,
+        use_async=use_async,
+    )
+    return dispatcher, servicer
+
+
+def test_pipelined_single_worker_converges(tmp_path):
+    """One-stale gradients (the pipeline's steady state) still converge
+    on the linear fixture; the job completes with every task reported."""
+    dispatcher, servicer = make_job(tmp_path, epochs=8)
+    master = InProcessMaster(servicer)
+    spec = spec_from_module(linear_module)
+    worker = Worker(0, master, spec, minibatch_size=16, step_pipeline=1)
+    assert worker.run()
+    assert dispatcher.finished()
+    params, _aux, _v = servicer.get_params_copy()
+    kernel = np.asarray(params["Dense_0"]["kernel"]).ravel()[0]
+    bias = np.asarray(params["Dense_0"]["bias"]).ravel()[0]
+    assert abs(kernel - 2.0) < 0.3
+    assert abs(bias - 1.0) < 0.3
+
+
+def test_pipelined_depth2_converges(tmp_path):
+    """Depth-2: up to two reports in flight, gradients up to 2-stale;
+    the PS down-weights them and training still converges."""
+    dispatcher, servicer = make_job(tmp_path, epochs=8, staleness_window=2)
+    master = InProcessMaster(servicer)
+    spec = spec_from_module(linear_module)
+    worker = Worker(0, master, spec, minibatch_size=16, step_pipeline=2)
+    assert worker.run()
+    assert dispatcher.finished()
+    params, _aux, _v = servicer.get_params_copy()
+    kernel = np.asarray(params["Dense_0"]["kernel"]).ravel()[0]
+    assert abs(kernel - 2.0) < 0.4
+
+
+def test_pipelined_rejection_falls_back_to_serial_retry(tmp_path):
+    """Reports forced beyond the staleness window are rejected; the
+    join path must re-train those batches serially and still finish."""
+    dispatcher, servicer = make_job(tmp_path, epochs=2, staleness_window=1)
+    state = {"n": 0}
+
+    def make_stale(req):
+        state["n"] += 1
+        if state["n"] % 3 == 0:
+            req = dict(req)
+            req["version"] = req["version"] - 5  # far beyond the window
+        return req
+
+    master = InProcessMaster(
+        servicer, intercept={"ReportGradient": make_stale}
+    )
+    spec = spec_from_module(linear_module)
+    worker = Worker(0, master, spec, minibatch_size=16, step_pipeline=1)
+    assert worker.run()
+    assert dispatcher.finished()
+    # every rejection forced at least one retry report
+    assert master.calls["ReportGradient"] > servicer.version
+
+
+def test_pipelined_two_workers(tmp_path):
+    dispatcher, servicer = make_job(
+        tmp_path, epochs=2, staleness_window=2
+    )
+    master = InProcessMaster(servicer)
+    workers = [
+        Worker(
+            i,
+            master,
+            spec_from_module(linear_module),
+            minibatch_size=16,
+            step_pipeline=1,
+        )
+        for i in range(2)
+    ]
+    threads = [threading.Thread(target=w.run) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert dispatcher.finished()
+    assert servicer.version > 0
+
+
+def test_pipelined_sharded_ps(tmp_path):
+    """Pipeline x sharded PS: the compute-time shard versions ride the
+    fan-out while the next batch computes."""
+    from elasticdl_tpu.master.ps_group import PSShardGroup
+
+    group = PSShardGroup(
+        2,
+        mode="inproc",
+        optimizer_factory=linear_module.optimizer,
+        use_async=True,
+    )
+    group.start()
+    try:
+        dispatcher, servicer = make_job(tmp_path, epochs=2, use_async=True)
+        servicer._ps_group = servicer.ps_group = group
+        worker = Worker(
+            0,
+            InProcessMaster(servicer),
+            spec_from_module(linear_module),
+            minibatch_size=16,
+            ps_endpoints=group.endpoints,
+            step_pipeline=1,
+        )
+        assert worker.run()
+        assert dispatcher.finished()
+        versions, vec = group.assemble()
+        assert min(versions) > 0 and vec is not None
+    finally:
+        group.stop()
+
+
+def test_resolve_step_pipeline_auto():
+    """Auto (-1) turns the pipeline on exactly when it is legal."""
+
+    def args_for(extra):
+        return master_parser().parse_args(
+            ["--model_zoo", "z", "--model_def", "m.f", "--minibatch_size", "8"]
+            + extra
+        )
+
+    assert resolve_step_pipeline(args_for([])) == 0  # strict sync
+    assert resolve_step_pipeline(args_for(["--staleness_window", "1"])) == 1
+    assert resolve_step_pipeline(args_for(["--staleness_window", "8"])) == 4
+    assert resolve_step_pipeline(args_for(["--use_async"])) == 4
+    # window mode has its own pipeline; per-step stays off
+    assert (
+        resolve_step_pipeline(
+            args_for(["--staleness_window", "1", "--local_updates", "4"])
+        )
+        == 0
+    )
+    # explicit depth wins over auto; sync clamps to the window
+    assert resolve_step_pipeline(args_for(["--step_pipeline", "0"])) == 0
+    assert (
+        resolve_step_pipeline(
+            args_for(["--step_pipeline", "3", "--use_async"])
+        )
+        == 3
+    )
+    assert (
+        resolve_step_pipeline(
+            args_for(["--step_pipeline", "3", "--staleness_window", "2"])
+        )
+        == 2
+    )
