@@ -53,13 +53,24 @@ class IndexedRows:
         self.indices = np.asarray(self.indices, dtype=np.int64)
 
 
-def merge_indexed_rows(slices: list[IndexedRows]) -> IndexedRows:
+def merge_indexed_rows(
+    slices: list[IndexedRows], dedup: bool = False
+) -> IndexedRows:
     """Concatenate several IndexedRows (reference:
-    elasticdl/python/common/tensor_helper.py:4-8)."""
-    return IndexedRows(
+    elasticdl/python/common/tensor_helper.py:4-8). With dedup=True,
+    duplicate-id rows are summed (same math the PS sparse-apply runs
+    first thing) — senders use it to shrink multi-step accumulations
+    before they hit the wire."""
+    out = IndexedRows(
         values=np.concatenate([s.values for s in slices], axis=0),
         indices=np.concatenate([s.indices for s in slices], axis=0),
     )
+    if not dedup:
+        return out
+    uniq, inverse = np.unique(out.indices, return_inverse=True)
+    summed = np.zeros((len(uniq),) + out.values.shape[1:], dtype=np.float32)
+    np.add.at(summed, inverse, np.asarray(out.values, dtype=np.float32))
+    return IndexedRows(values=summed, indices=uniq)
 
 
 def _dtype_to_str(dt: np.dtype) -> str:

@@ -463,6 +463,13 @@ class MasterServicer:
                 self._params,
                 delta,
             )
+            edl_grads = req.get("edl_gradient") or {}
+            if edl_grads and self._sparse_opt is not None:
+                # the window's accumulated BET gradients: applied at
+                # full weight like the per-step path (_apply never
+                # scales sparse grads — the slot state, not an LR
+                # damper, governs sparse staleness)
+                self._sparse_opt.apply_gradients(edl_grads)
             if aux_state is not None:
                 self._aux = aux_state
             self._version += steps
@@ -655,12 +662,28 @@ class MasterServicer:
     # -- checkpoint helpers (called from master main) ------------------------
 
     def save_latest_checkpoint(self, output_path: str):
-        """reference: servicer.py:255-267."""
+        """reference: servicer.py:255-267. The final model carries the
+        embedding tables too — without them a deepfm-style `--output`
+        artifact would be unusable for serving/resume (the periodic
+        CheckpointService snapshots them; the final save must match)."""
         from elasticdl_tpu.master.checkpoint import save_model_file
 
+        emb = (
+            self._embedding_store.snapshot()
+            if self._embedding_store is not None
+            else None
+        )
         if self._ps_group is not None:
             params, aux, version = self.get_params_copy()
-            save_model_file(output_path, params, version, aux=aux)
+            save_model_file(
+                output_path, params, version, aux=aux, embeddings=emb
+            )
             return
         with self._lock:
-            save_model_file(output_path, self._params, self._version, aux=self._aux)
+            save_model_file(
+                output_path,
+                self._params,
+                self._version,
+                aux=self._aux,
+                embeddings=emb,
+            )

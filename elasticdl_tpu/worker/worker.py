@@ -185,11 +185,13 @@ class Worker:
         # (doc/worker_optimization_design.md:33-60): get_batch /
         # compute / get_model / report_gradient / sync_wait / read
         self.timers = PhaseTimers()
-        if local_updates and model_spec.embedding_specs:
-            raise ValueError(
-                "local_updates mode does not support PS-resident "
-                "embeddings (sparse grads must reach the PS every step)"
-            )
+        # Elastic embeddings compose with window mode: BET gradients
+        # are extracted per step (device) and accumulated, then flushed
+        # to the PS's sparse optimizer with the window's delta sync —
+        # within a window, lookups see the store as of the last flush
+        # (window-deep sparse staleness, the sparse analog of the dense
+        # delta). Window=1 is exactly the per-step math.
+        self._pending_edl: list = []  # [(BatchEmbeddings, gbets_dev)]
         if ps_endpoints and model_spec.embedding_specs:
             raise ValueError(
                 "sharded PS does not support elastic-embedding models "
@@ -698,6 +700,58 @@ class Worker:
 
         return step
 
+    def _build_local_emb_step(self):
+        """Embedding-aware local step: like `_local_step_core` but the
+        loss also differentiates w.r.t. the batch embedding tables; the
+        dense update still runs on device, while the BET gradients come
+        back for host-side accumulation into the window's IndexedRows
+        flush (reference slot semantics: optimizer_wrapper.py:415-433)."""
+        assert self._use_flat(), "local mode requires flat transport"
+        spec = self._spec
+        tx = spec.optimizer()
+        unravel = self._unravel
+
+        def step(flat, opt_state, aux, bets, bet_aux, features, labels):
+            def loss_fn(flat, bets):
+                params = unravel(flat)
+                embeddings = {
+                    k: EmbeddingInput(bets[k], bet_aux[k][0], bet_aux[k][1])
+                    for k in bets
+                }
+                variables = {"params": params, **aux}
+                outputs, new_aux = self._apply_model(
+                    variables, features, embeddings, train=True
+                )
+                return spec.loss(outputs, labels), new_aux
+
+            (loss, new_aux), (gflat, gbets) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True
+            )(flat, bets)
+            updates, opt_state = tx.update(gflat, opt_state, flat)
+            return (
+                flat + updates,
+                opt_state,
+                new_aux if new_aux else aux,
+                loss,
+                gbets,
+            )
+
+        if self._mesh is None or self._mesh.size <= 1:
+            return jax.jit(step, donate_argnums=(0, 1))
+        # local dp mesh, like every sibling step builder: batch-carrying
+        # inputs shard over the dp axis, params/BETs replicate, and the
+        # replicated out_shardings make XLA all-reduce the gradients
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(self._mesh, P())
+        batch = NamedSharding(self._mesh, P(self._mesh.axis_names[0]))
+        return jax.jit(
+            step,
+            in_shardings=(repl, repl, repl, repl, batch, batch, batch),
+            out_shardings=repl,
+            donate_argnums=(0, 1),
+        )
+
     def _ensure_local_ready(self, features, task: Task):
         """Window-boundary preamble shared by the per-step and scanned
         local paths: absorb any in-flight sync, (re)pull or lazily init
@@ -715,9 +769,7 @@ class Worker:
                 self._join_sync()  # model swap: settle the chain first
             if not self._fresh or self._version < task.model_version:
                 if not self.pull_model(max(self._version, task.model_version)):
-                    self._init_model(features, None)
-                    self.report_variable()
-                    self.pull_model()
+                    self._lazy_init_model(features)
                 self._opt_state = None  # params swapped: restart opt state
         if self._opt_state is None:
             with self.timers.phase("rebase"):
@@ -728,11 +780,35 @@ class Worker:
 
     def _local_minibatch(self, features, labels, task: Task):
         self._ensure_local_ready(features, task)
-        if self._local_step_fn is None:
-            self._local_step_fn = self._build_local_step()
-        self._flat, self._opt_state, new_aux, loss = self._local_step_fn(
-            self._flat, self._opt_state, self._aux, features, labels
-        )
+        if self._emb_specs:
+            if self._local_step_fn is None:
+                self._local_step_fn = self._build_local_emb_step()
+            embs = self._prepare_embeddings(features)
+            bets = {k: b.bet for k, b in embs.items()}
+            bet_aux = {k: (b.inverse, b.mask) for k, b in embs.items()}
+            (
+                self._flat,
+                self._opt_state,
+                new_aux,
+                loss,
+                gbets,
+            ) = self._local_step_fn(
+                self._flat,
+                self._opt_state,
+                self._aux,
+                bets,
+                bet_aux,
+                features,
+                labels,
+            )
+            # device refs only; the d2h rides the window sync's batch
+            self._pending_edl.append((embs, gbets))
+        else:
+            if self._local_step_fn is None:
+                self._local_step_fn = self._build_local_step()
+            self._flat, self._opt_state, new_aux, loss = self._local_step_fn(
+                self._flat, self._opt_state, self._aux, features, labels
+            )
         self._aux = new_aux or self._aux
         self._pending_steps += 1
         self._latest_step_loss = loss
@@ -798,6 +874,19 @@ class Worker:
         each as one scanned device call; ragged tails (short windows or
         a short final batch) fall back to the per-step path."""
         W = self._local_updates
+        if self._emb_specs:
+            # embedding models step per batch inside the window (each
+            # batch's BET has its own bucketed shape, so windows can't
+            # stack into one scan); the dense optimizer still runs on
+            # device and the sparse flush rides the window sync
+            loss = None
+            while True:
+                with self.timers.phase("get_batch"):
+                    batch = next(batches, None)
+                if batch is None:
+                    return loss
+                with self.timers.phase("compute"):
+                    loss = self._local_minibatch(batch[0], batch[1], task)
         buf = []
         loss = None
         done = False
@@ -853,8 +942,13 @@ class Worker:
             self._check_sync_error()
             self._absorb_sync_result()
         if not self._pending_steps:
-            if blocking:
-                self._flush_deferred_reports()
+            # flush COVERED deferred reports even on the non-blocking
+            # path: when the covering sync landed before the task's
+            # defer registered (fast master / serialized chain), no
+            # later do_sync will run to flush it and the task would
+            # stay un-reported forever (uncovered entries are left for
+            # their sync's own flush)
+            self._flush_deferred_reports()
             return
         delta_dev = self._flat - self._base_flat  # own buffer, thread-safe
         if self._transport_dtype == "bfloat16" and _BF16 is not None:
@@ -864,6 +958,8 @@ class Worker:
         aux_dev = self._aux  # device refs; materialized in the thread
         losses = self._pending_losses  # resolved in the same d2h round
         self._pending_losses = []
+        pending_edl = self._pending_edl  # this window's BET grads
+        self._pending_edl = []
         # the delta's OWN newest step loss — feeds the master's metrics
         # sink attributed to the version this delta produces (task-end
         # losses in `losses` can belong to earlier windows)
@@ -889,11 +985,18 @@ class Worker:
                     # never reached the PS — do NOT send it, do NOT
                     # touch worker state, do NOT flush reports.
                     return
-            # ONE batched d2h round (device_get) for delta + aux + the
-            # window's task losses — per-item np.asarray would cost a
-            # full round-trip each over a high-latency host<->TPU link.
-            delta_h, aux_h, loss_h, step_loss_h = jax.device_get(
-                (delta_dev, aux_dev or None, [l for _, l in losses], step_loss)
+            # ONE batched d2h round (device_get) for delta + aux + BET
+            # grads + the window's task losses — per-item np.asarray
+            # would cost a full round-trip each over a high-latency
+            # host<->TPU link.
+            delta_h, aux_h, loss_h, step_loss_h, gbets_h = jax.device_get(
+                (
+                    delta_dev,
+                    aux_dev or None,
+                    [l for _, l in losses],
+                    step_loss,
+                    [g for _, g in pending_edl],
+                )
             )
             with self._report_lock:
                 base_version = self._base_version
@@ -903,6 +1006,29 @@ class Worker:
                 "base_version": base_version,
                 "aux_state": aux_h,
             }
+            if pending_edl:
+                # the window's sparse plane: per-step IndexedRows merged
+                # per table, applied by the PS's sparse optimizer with
+                # this delta (slot semantics: optimizer_wrapper.py:415-433)
+                from elasticdl_tpu.common.codec import merge_indexed_rows
+
+                per_table: dict = {}
+                for (embs, _g), gb in zip(pending_edl, gbets_h):
+                    for name, grad in gb.items():
+                        rows = extract_indexed_grads(
+                            self._emb_specs[name],
+                            np.asarray(grad),
+                            embs[name],
+                        )
+                        per_table.setdefault(name, []).append(rows)
+                # dedup=True: ids recurring across the window's steps
+                # collapse to one summed row BEFORE the wire — same
+                # math the PS applies, several-fold fewer bytes on the
+                # high-latency link
+                req["edl_gradient"] = {
+                    name: merge_indexed_rows(slices, dedup=True)
+                    for name, slices in per_table.items()
+                }
             if self._transport_dtype == "bfloat16":
                 # merged-model piggyback in bf16: halves the response
                 # bytes on every multi-worker window sync
@@ -1063,6 +1189,7 @@ class Worker:
         self._opt_state = None
         self._pending_steps = 0
         self._pending_losses = []
+        self._pending_edl = []
 
     def _absorb_sync_result(self):
         """Apply a piggybacked merged model (another worker advanced
@@ -1157,23 +1284,34 @@ class Worker:
                 },
             )
 
+    def _lazy_init_model(self, features):
+        """The lazy PS-init handshake, ONE definition for every path
+        (per-step, local/window, warm-up): init locally (with real BET
+        slices when the model takes embeddings), offer the variables to
+        the PS (SETNX — first worker wins), pull whatever won.
+        Reference: worker.py:278-282, servicer.py:299-303."""
+        init_embs = None
+        if self._emb_specs:
+            init_embs = self._dev_embedding_inputs(
+                self._prepare_embeddings(features)
+            )
+        self._init_model(features, init_embs)
+        self.report_variable()
+        self.pull_model()
+
     def _ensure_step_ready(self, features, task: Task):
-        """Shared per-step preamble: model freshness (pull, or the lazy
-        PS init handshake when the master is uninitialized — reference
-        worker.py:278-282, servicer.py:299-303), then the step build
-        (after the first pull/init so the flat-transport template is
-        known). Used by both the serial retry loop and the pipelined
-        path — the handshake must never fork."""
+        """Shared per-step preamble: model freshness (pull or lazy
+        init), then the step build (after the first pull/init so the
+        flat-transport template is known). Used by both the serial
+        retry loop and the pipelined path — the handshake must never
+        fork."""
         if not self._fresh or self._version < task.model_version:
             with self.timers.phase("get_model"):
                 pulled = self.pull_model(
                     max(self._version, task.model_version)
                 )
             if not pulled:
-                embs = self._prepare_embeddings(features)
-                self._init_model(features, self._dev_embedding_inputs(embs))
-                self.report_variable()
-                self.pull_model()
+                self._lazy_init_model(features)
         if self._train_step is None:
             self._train_step = self._build_train_step()
             self._eval_step = self._build_eval_step()
@@ -1506,6 +1644,12 @@ class Worker:
         doc/worker_optimization_design.md:186-191)."""
         assert self._local_updates > 1, "window warm-up needs local mode"
         first = jax.tree_util.tree_map(lambda a: a[0], features)
+        if self._emb_specs:
+            # embedding models step per batch (no stacked scan): warm
+            # the per-batch emb step on the first slice, on THROWAWAY
+            # state — the local flat must not advance unreported
+            self._warmup_emb_local(first, labels[0])
+            return
         self._warmup_params(first)
         if self._local_window_fn is None:
             self._local_window_fn = self._build_local_window_fn()
@@ -1548,6 +1692,30 @@ class Worker:
         # block_until_ready returns early (remote-device tunnels)
         jax.device_get(out[3])
 
+    def _warmup_emb_local(self, features, labels):
+        """Compile+execute the embedding-aware local step once on
+        COPIES (the step donates its param/opt buffers; feeding it
+        copies leaves the real local state untouched, so no unreported
+        advance offsets later deltas against the PS base)."""
+        self._warmup_params(features)
+        if self._local_step_fn is None:
+            self._local_step_fn = self._build_local_emb_step()
+        self.window_flops = None
+        embs = self._prepare_embeddings(features)
+        bets = {k: b.bet for k, b in embs.items()}
+        bet_aux = {k: (b.inverse, b.mask) for k, b in embs.items()}
+        tx = self._spec.optimizer()
+        out = self._local_step_fn(
+            jnp.copy(self._flat),
+            tx.init(jnp.copy(self._flat)),
+            self._aux,
+            bets,
+            bet_aux,
+            features,
+            labels,
+        )
+        jax.device_get(out[3])
+
     def warmup_sync_step(self, features, labels):
         """AOT warm-up of the per-step sync path for [B, ...] shapes:
         compiles the jitted train step and executes it once (results
@@ -1565,9 +1733,7 @@ class Worker:
         """Ensure params exist (pull from the PS or lazily init it)."""
         if self._flat is None and self._params is None:
             if not self.pull_model():
-                self._init_model(features, None)
-                self.report_variable()
-                self.pull_model()
+                self._lazy_init_model(features)
 
     # ------------------------------------------------------------- main loop
 
