@@ -46,6 +46,30 @@ def ps_pod_name(job_name: str, shard_id: int) -> str:
     return f"elasticdl-{job_name}-ps-{shard_id}"
 
 
+def kv_pod_name(job_name: str, shard_id: int) -> str:
+    return f"elasticdl-{job_name}-kv-{shard_id}"
+
+
+def build_kv_pod_manifest(
+    job_name: str,
+    shard_id: int,
+    image: str,
+    command: List[str],
+    **kwargs,
+) -> dict:
+    """An embedding KV shard pod (master/kv_shard_main.py) — the
+    sharded analog of the reference's Redis embedding pod
+    (embedding_service.py:231-268). Replica type "kv": job-lifetime
+    service, watched for fail-fast like "ps" shards."""
+    pod = build_worker_pod_manifest(
+        job_name, shard_id, image, command, **kwargs
+    )
+    pod["metadata"]["name"] = kv_pod_name(job_name, shard_id)
+    pod["metadata"]["labels"][ELASTICDL_REPLICA_TYPE_KEY] = "kv"
+    pod["spec"]["containers"][0]["name"] = "kv"
+    return pod
+
+
 def build_ps_pod_manifest(
     job_name: str,
     shard_id: int,
@@ -425,18 +449,17 @@ class K8sBackend(PodBackend):
         except Exception:
             logger.warning("delete pod %s failed:\n%s", name, traceback.format_exc())
 
-    def create_ps_shard(
-        self, shard_id: int, argv: List[str], port: int = 2223
+    def _create_shard_pod(
+        self, build_fn, shard_id: int, module: str, argv, port: int
     ) -> str:
-        """Create a PS shard pod (no wait); returns the pod name.
-        Shards are job-lifetime: no relaunch machinery."""
-        pod = build_ps_pod_manifest(
+        """Shared shard-pod creation (PS and KV differ only in name/
+        label/entry module/port). Shards are job-lifetime: no relaunch
+        machinery; the watch fails the job fast when one dies."""
+        pod = build_fn(
             self._job_name,
             shard_id,
             self._image,
-            ["python", "-m", "elasticdl_tpu.master.ps_shard_main"]
-            + list(argv)
-            + ["--port", str(port)],
+            ["python", "-m", module] + list(argv) + ["--port", str(port)],
             namespace=self._namespace,
             resource_request=self._ps_resource_request,
             resource_limit=self._ps_resource_limit,
@@ -447,23 +470,51 @@ class K8sBackend(PodBackend):
         pod = apply_cluster_spec(pod, self._cluster_spec)
         self._core.create_namespaced_pod(self._namespace, pod)
         name = pod["metadata"]["name"]
-        logger.info("Created PS shard pod %s", name)
+        logger.info("Created shard pod %s", name)
         return name
+
+    def _wait_shard_ip(self, name: str, port: int, timeout: float) -> str:
+        """Endpoint of a created shard pod, once it has an IP. A pod
+        that reaches a terminal phase while waiting fails immediately
+        instead of burning the whole timeout."""
+        import time as _time
+
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            status = self._core.read_namespaced_pod(name, self._namespace).status
+            if status and status.phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
+                raise RuntimeError(
+                    f"shard pod {name} terminated ({status.phase}) "
+                    "before serving"
+                )
+            if status and status.pod_ip:
+                return f"{status.pod_ip}:{port}"
+            _time.sleep(2)
+        raise TimeoutError(f"shard pod {name} never got an IP")
+
+    def _delete_pod(self, name: str):
+        try:
+            self._core.delete_namespaced_pod(name, self._namespace)
+        except Exception:
+            logger.warning("delete pod %s failed:\n%s", name, traceback.format_exc())
+
+    def create_ps_shard(
+        self, shard_id: int, argv: List[str], port: int = 2223
+    ) -> str:
+        return self._create_shard_pod(
+            build_ps_pod_manifest,
+            shard_id,
+            "elasticdl_tpu.master.ps_shard_main",
+            argv,
+            port,
+        )
 
     def wait_ps_shard_ip(
         self, shard_id: int, port: int = 2223, timeout: float = 300.0
     ) -> str:
-        """Endpoint of a created PS shard pod, once it has an IP."""
-        import time as _time
-
-        name = ps_pod_name(self._job_name, shard_id)
-        deadline = _time.time() + timeout
-        while _time.time() < deadline:
-            status = self._core.read_namespaced_pod(name, self._namespace).status
-            if status and status.pod_ip:
-                return f"{status.pod_ip}:{port}"
-            _time.sleep(2)
-        raise TimeoutError(f"PS shard pod {name} never got an IP")
+        return self._wait_shard_ip(
+            ps_pod_name(self._job_name, shard_id), port, timeout
+        )
 
     def start_ps_shard(
         self, shard_id: int, argv: List[str], port: int = 2223
@@ -475,11 +526,28 @@ class K8sBackend(PodBackend):
         return self.wait_ps_shard_ip(shard_id, port)
 
     def delete_ps_shard(self, shard_id: int):
-        name = ps_pod_name(self._job_name, shard_id)
-        try:
-            self._core.delete_namespaced_pod(name, self._namespace)
-        except Exception:
-            logger.warning("delete pod %s failed:\n%s", name, traceback.format_exc())
+        self._delete_pod(ps_pod_name(self._job_name, shard_id))
+
+    def create_kv_shard(
+        self, shard_id: int, argv: List[str], port: int = 2224
+    ) -> str:
+        return self._create_shard_pod(
+            build_kv_pod_manifest,
+            shard_id,
+            "elasticdl_tpu.master.kv_shard_main",
+            argv,
+            port,
+        )
+
+    def wait_kv_shard_ip(
+        self, shard_id: int, port: int = 2224, timeout: float = 300.0
+    ) -> str:
+        return self._wait_shard_ip(
+            kv_pod_name(self._job_name, shard_id), port, timeout
+        )
+
+    def delete_kv_shard(self, shard_id: int):
+        self._delete_pod(kv_pod_name(self._job_name, shard_id))
 
     def _watch(self):
         """Label-selector pod watch on a daemon thread
@@ -504,7 +572,7 @@ class K8sBackend(PodBackend):
                     # failing (a slow crash-loop) — the event lets the
                     # WorkerManager fail the job fast instead
                     rtype = labels.get(ELASTICDL_REPLICA_TYPE_KEY)
-                    if rtype not in ("worker", "ps"):
+                    if rtype not in ("worker", "ps", "kv"):
                         continue
                     wid = int(labels.get(ELASTICDL_REPLICA_INDEX_KEY, -1))
                     if event["type"] == "DELETED":

@@ -117,6 +117,17 @@ def add_master_args(parser: argparse.ArgumentParser):
         help="sharded-PS hosting: dedicated subprocesses (default) or "
         "threads inside the master (tests/single-host)",
     )
+    parser.add_argument(
+        "--num_kv_shards", type=non_neg_int, default=0,
+        help="N>0: host the embedding tables behind N KV shard "
+        "endpoints (workers look rows up directly, bypassing the "
+        "master — the reference's worker->Redis topology); 0: tables "
+        "live in the master process",
+    )
+    parser.add_argument(
+        "--kv_mode", default="process", choices=("process", "inproc"),
+        help="KV shard hosting, like --ps_mode",
+    )
     parser.add_argument("--eval_steps", type=non_neg_int, default=0)
     parser.add_argument("--eval_start_delay_secs", type=float, default=0.0)
     parser.add_argument("--eval_throttle_secs", type=float, default=0.0)

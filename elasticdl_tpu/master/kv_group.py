@@ -1,0 +1,157 @@
+"""Master-side lifecycle manager for the embedding KV shard endpoints.
+
+Same hosting modes and job-lifetime semantics as the dense
+`PSShardGroup` (ps_group.py): ``inproc`` threads for tests/single-host,
+``process`` subprocesses of ``kv_shard_main``, ``k8s`` dedicated pods.
+The reference's equivalent is the Redis-cluster pod spawned at master
+boot (reference: elasticdl/python/master/embedding_service.py:82-99,
+:231-268); a dead shard fails the job (no relaunch), like a dead Redis
+node there.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import List, Optional
+
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.rpc.kv_client import ShardedEmbeddingStore
+
+logger = get_logger(__name__)
+
+
+class KVShardGroup:
+    """Owns N embedding KV shard endpoints for one job."""
+
+    def __init__(
+        self,
+        num_shards: int,
+        mode: str = "inproc",
+        boot_timeout: float = 60.0,
+        k8s_backend=None,
+    ):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        if mode not in ("inproc", "process", "k8s"):
+            raise ValueError(f"unknown kv group mode {mode!r}")
+        if mode == "k8s" and k8s_backend is None:
+            raise ValueError("k8s mode needs the cluster backend")
+        self._n = num_shards
+        self._mode = mode
+        self._boot_timeout = boot_timeout
+        self._k8s_backend = k8s_backend
+        self.endpoints: List[str] = []
+        self._servers = []
+        self._procs: List[subprocess.Popen] = []
+        self._k8s_created = 0  # pods created (>= endpoints resolved)
+        self._store: Optional[ShardedEmbeddingStore] = None
+
+    def start(self) -> List[str]:
+        if self.endpoints:
+            return self.endpoints
+        if self._mode == "inproc":
+            self._start_inproc()
+        elif self._mode == "k8s":
+            for i in range(self._n):
+                self._k8s_backend.create_kv_shard(
+                    i, ["--shard_id", str(i), "--num_shards", str(self._n)]
+                )
+                self._k8s_created = i + 1
+            for i in range(self._n):
+                self.endpoints.append(
+                    self._k8s_backend.wait_kv_shard_ip(
+                        i, timeout=self._boot_timeout * 5
+                    )
+                )
+        else:
+            self._start_process()
+        logger.info(
+            "KV shard group up (%s): %s", self._mode, ", ".join(self.endpoints)
+        )
+        return self.endpoints
+
+    def _start_inproc(self):
+        from elasticdl_tpu.master.kv_shard import KVShardServicer
+        from elasticdl_tpu.rpc.server import RpcServer
+
+        for i in range(self._n):
+            server = RpcServer(
+                KVShardServicer(i, self._n).handlers(), port=0
+            )
+            server.start()
+            self._servers.append(server)
+            self.endpoints.append(f"localhost:{server.port}")
+
+    def _start_process(self):
+        tmp = tempfile.mkdtemp(prefix="edl_kv_")
+        port_files = []
+        for i in range(self._n):
+            port_file = os.path.join(tmp, f"kv-{i}.port")
+            port_files.append(port_file)
+            argv = [
+                sys.executable,
+                "-m",
+                "elasticdl_tpu.master.kv_shard_main",
+                "--port", "0",
+                "--port_file", port_file,
+                "--shard_id", str(i),
+                "--num_shards", str(self._n),
+            ]
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"  # row storage never needs a chip
+            import elasticdl_tpu
+
+            pkg_root = os.path.dirname(os.path.dirname(elasticdl_tpu.__file__))
+            env["PYTHONPATH"] = (
+                pkg_root + os.pathsep + env["PYTHONPATH"]
+                if env.get("PYTHONPATH")
+                else pkg_root
+            )
+            self._procs.append(subprocess.Popen(argv, env=env))
+        deadline = time.time() + self._boot_timeout
+        for i, pf in enumerate(port_files):
+            while not os.path.exists(pf):
+                if self._procs[i].poll() is not None:
+                    raise RuntimeError(
+                        f"KV shard {i} exited rc={self._procs[i].returncode} "
+                        "before publishing its port"
+                    )
+                if time.time() > deadline:
+                    raise TimeoutError(f"KV shard {i} did not publish a port")
+                time.sleep(0.05)
+            with open(pf) as f:
+                self.endpoints.append(f"localhost:{int(f.read().strip())}")
+
+    def store(self) -> ShardedEmbeddingStore:
+        """The master's store client (SparseOptimizer + checkpoints)."""
+        if self._store is None:
+            self._store = ShardedEmbeddingStore(self.endpoints)
+            self._store.wait_ready(self._boot_timeout)
+        return self._store
+
+    def stop(self):
+        if self._store is not None:
+            self._store.close()
+            self._store = None
+        for s in self._servers:
+            s.stop()
+        self._servers = []
+        # delete every CREATED pod, not only resolved endpoints — a
+        # partially-booted group (IP wait timed out) must not leak pods
+        for i in range(self._k8s_created):
+            self._k8s_backend.delete_kv_shard(i)
+        self._k8s_created = 0
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs = []
+        self.endpoints = []

@@ -1,0 +1,97 @@
+"""KV shard: one slice of the scale-out embedding service.
+
+The reference externalizes its embedding tables into a 6-node Redis
+Cluster in a dedicated pod (reference:
+elasticdl/python/master/embedding_service.py:82-99 cluster create,
+:231-268 pod) so table memory and lookup bandwidth scale independently
+of the master, and workers hit the store DIRECTLY
+(reference: elasticdl/python/worker/worker.py:126-169). This rebuild
+replaces Redis with N shard endpoints, each wrapping the framework's
+own store (`master/embedding_store.py` — the C++ arena when built,
+the lock-striped Python store otherwise) behind the generic RPC
+server.
+
+Row placement is id-hash: id -> shard `id % num_shards`, computed
+client-side (`rpc/kv_client.ShardedEmbeddingStore`) — no routing tier.
+Slot rows (`<layer>/slot/m` etc.) key by the same ids, so a row and
+its optimizer slots always co-locate on one shard.
+
+Wire format for snapshot/restore: {layer: (ids[n], values[n, dim])}
+arrays — the nested {id: row} dict form does not survive msgpack's
+string-key maps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from elasticdl_tpu.master.embedding_store import EmbeddingStore
+
+
+def snapshot_to_arrays(
+    snap: Dict[str, Dict[int, np.ndarray]]
+) -> Dict[str, Any]:
+    """{layer: {id: row}} -> {layer: {"ids": [n], "values": [n, dim]}}."""
+    out = {}
+    for layer, rows in snap.items():
+        if not rows:
+            continue
+        ids = np.fromiter(rows.keys(), dtype=np.int64, count=len(rows))
+        values = np.stack([rows[i] for i in ids])
+        out[layer] = {"ids": ids, "values": values}
+    return out
+
+
+def arrays_to_snapshot(
+    wire: Dict[str, Any]
+) -> Dict[str, Dict[int, np.ndarray]]:
+    return {
+        layer: {
+            int(i): np.asarray(v)
+            for i, v in zip(entry["ids"], entry["values"])
+        }
+        for layer, entry in wire.items()
+    }
+
+
+class KVShardServicer:
+    """One shard's RPC surface over a local EmbeddingStore."""
+
+    def __init__(self, shard_id: int, num_shards: int):
+        self.shard_id = int(shard_id)
+        self.num_shards = int(num_shards)
+        self._store = EmbeddingStore()
+
+    def handlers(self) -> Dict[str, Any]:
+        return {
+            "KVLookup": self.kv_lookup,
+            "KVUpdate": self.kv_update,
+            "KVSnapshot": self.kv_snapshot,
+            "KVRestore": self.kv_restore,
+            "KVLen": self.kv_len,
+        }
+
+    def kv_lookup(self, req: dict) -> dict:
+        values, unknown = self._store.lookup(req["layer"], req["ids"])
+        return {"values": values, "unknown_index": unknown}
+
+    def kv_update(self, req: dict) -> dict:
+        self._store.update(
+            req["layer"],
+            req["ids"],
+            req["values"],
+            set_if_not_exist=req.get("set_if_not_exist", False),
+        )
+        return {}
+
+    def kv_snapshot(self, req: dict) -> dict:
+        return {"layers": snapshot_to_arrays(self._store.snapshot())}
+
+    def kv_restore(self, req: dict) -> dict:
+        self._store.restore(arrays_to_snapshot(req.get("layers") or {}))
+        return {}
+
+    def kv_len(self, req: dict) -> dict:
+        return {"n": len(self._store)}

@@ -127,60 +127,88 @@ def build_master(args, job_type: str, cluster_backend=None):
         else {}
     )
     store = sparse_opt = None
-    if spec.embedding_specs:
-        store = EmbeddingStore()
-        sparse_opt = SparseOptimizer(store, **(spec.sparse_optimizer or {}))
-
-    # Sharded PS (master/ps_shard.py): the dense model behind N
-    # endpoints; workers push/pull slices in parallel while the master
-    # keeps the control plane. See ps_shard.py for the consistency
-    # model and validate_ps_args for the protocol constraints.
+    kv_group = None
     ps_group = None
-    if getattr(args, "num_ps", 0) > 0:
-        from elasticdl_tpu.common.args import (
-            ps_shard_forward_args,
-            validate_ps_args,
-        )
-        from elasticdl_tpu.master.ps_group import PSShardGroup
-
-        validate_ps_args(args)
-        if spec.embedding_specs:
-            raise ValueError(
-                "--num_ps does not support elastic-embedding models: "
-                "sparse tables live in the master-resident store and "
-                "their per-step gradients need the master path"
-            )
-        # k8s jobs need worker-REACHABLE shard endpoints: localhost
-        # subprocesses inside the master pod are invisible to worker
-        # pods, so the shards become dedicated pods addressed by pod IP
-        mode = getattr(args, "ps_mode", "process")
-        if getattr(args, "worker_backend", "") == "k8s":
-            mode = "k8s"
-        ps_group = PSShardGroup(
-            args.num_ps,
-            mode=mode,
-            optimizer_factory=spec.optimizer,
-            shard_argv=ps_shard_forward_args(args),
-            grads_to_wait=args.grads_to_wait,
-            use_async=args.use_async,
-            lr_staleness_modulation=args.lr_staleness_modulation,
-            staleness_window=args.staleness_window,
-            k8s_backend=cluster_backend if mode == "k8s" else None,
-        )
-        ps_group.start()
-
+    # one try covers EVERYTHING after the first shard spawn: shard
+    # subprocesses/pods must not outlive a failed boot, whichever later
+    # step (optimizer construction, PS group boot, servicer wiring)
+    # raises
     try:
-        return _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
-                             training, evaluation, prediction)
+        if spec.embedding_specs:
+            if getattr(args, "num_kv_shards", 0) > 0:
+                # scale-out embedding service: tables live behind N KV
+                # shard endpoints (kv_group.py); the master's sparse
+                # optimizer and checkpoints reach them through the same
+                # store interface, and workers hit them DIRECTLY
+                from elasticdl_tpu.master.kv_group import KVShardGroup
+
+                kv_mode = getattr(args, "kv_mode", "process")
+                if getattr(args, "worker_backend", "") == "k8s":
+                    kv_mode = "k8s"  # pods: worker-reachable endpoints
+                kv_group = KVShardGroup(
+                    args.num_kv_shards,
+                    mode=kv_mode,
+                    k8s_backend=(
+                        cluster_backend if kv_mode == "k8s" else None
+                    ),
+                )
+                kv_group.start()
+                store = kv_group.store()
+            else:
+                store = EmbeddingStore()
+            sparse_opt = SparseOptimizer(
+                store, **(spec.sparse_optimizer or {})
+            )
+
+        # Sharded PS (master/ps_shard.py): the dense model behind N
+        # endpoints; workers push/pull slices in parallel while the
+        # master keeps the control plane. See ps_shard.py for the
+        # consistency model and validate_ps_args for the protocol
+        # constraints. Elastic-embedding models compose: dense slices
+        # ride the PS shards while the sparse IndexedRows ride
+        # ReportWindowMeta to the master's sparse optimizer (whose
+        # store may itself be the KV shard group).
+        if getattr(args, "num_ps", 0) > 0:
+            from elasticdl_tpu.common.args import (
+                ps_shard_forward_args,
+                validate_ps_args,
+            )
+            from elasticdl_tpu.master.ps_group import PSShardGroup
+
+            validate_ps_args(args)
+            # k8s jobs need worker-REACHABLE shard endpoints: localhost
+            # subprocesses inside the master pod are invisible to
+            # worker pods, so the shards become dedicated pods
+            # addressed by pod IP
+            mode = getattr(args, "ps_mode", "process")
+            if getattr(args, "worker_backend", "") == "k8s":
+                mode = "k8s"
+            ps_group = PSShardGroup(
+                args.num_ps,
+                mode=mode,
+                optimizer_factory=spec.optimizer,
+                shard_argv=ps_shard_forward_args(args),
+                grads_to_wait=args.grads_to_wait,
+                use_async=args.use_async,
+                lr_staleness_modulation=args.lr_staleness_modulation,
+                staleness_window=args.staleness_window,
+                k8s_backend=cluster_backend if mode == "k8s" else None,
+            )
+            ps_group.start()
+
+        return _finish_build(args, job_type, spec, ps_group, store,
+                             sparse_opt, training, evaluation, prediction,
+                             kv_group=kv_group)
     except Exception:
-        # shard subprocesses/pods must not outlive a failed boot
         if ps_group is not None:
             ps_group.stop()
+        if kv_group is not None:
+            kv_group.stop()
         raise
 
 
 def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
-                  training, evaluation, prediction):
+                  training, evaluation, prediction, kv_group=None):
     from elasticdl_tpu.master.checkpoint import (
         CheckpointService,
         load_model_file,
@@ -241,6 +269,7 @@ def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
         lr_staleness_modulation=args.lr_staleness_modulation,
         staleness_window=args.staleness_window,
         ps_group=ps_group,
+        kv_group=kv_group,
     )
     if ps_group is not None and init_params is not None:
         from elasticdl_tpu.common import codec
@@ -424,6 +453,8 @@ def main(argv=None) -> int:
             servicer.tb_service.close()
         if servicer.ps_group is not None:
             servicer.ps_group.stop()
+        if servicer.kv_group is not None:
+            servicer.kv_group.stop()
         backend.stop()
         server.stop()
     return exit_code

@@ -82,6 +82,7 @@ class PSShardGroup:
         self.endpoints: List[str] = []
         self._servers = []  # inproc RpcServers
         self._procs: List[subprocess.Popen] = []
+        self._k8s_created = 0  # pods created (>= endpoints resolved)
         self._client: Optional[ShardedPS] = None
         self._n_params = -1
 
@@ -124,6 +125,7 @@ class PSShardGroup:
         if hasattr(self._k8s_backend, "create_ps_shard"):
             for i in range(self._n):
                 self._k8s_backend.create_ps_shard(i, self._shard_cli_flags(i))
+                self._k8s_created = i + 1
             for i in range(self._n):
                 self.endpoints.append(
                     self._k8s_backend.wait_ps_shard_ip(
@@ -137,6 +139,7 @@ class PSShardGroup:
                         i, self._shard_cli_flags(i)
                     )
                 )
+                self._k8s_created = i + 1
 
     def _start_inproc(self):
         from elasticdl_tpu.master.ps_optimizer import PSOptimizer
@@ -207,9 +210,11 @@ class PSShardGroup:
         for s in self._servers:
             s.stop()
         self._servers = []
-        if self._mode == "k8s" and self.endpoints:
-            for i in range(self._n):
-                self._k8s_backend.delete_ps_shard(i)
+        # delete every CREATED pod, not only resolved endpoints — a
+        # partially-booted group (IP wait timed out) must not leak pods
+        for i in range(self._k8s_created):
+            self._k8s_backend.delete_ps_shard(i)
+        self._k8s_created = 0
         for p in self._procs:
             if p.poll() is None:
                 p.terminate()

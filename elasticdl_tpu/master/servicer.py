@@ -68,6 +68,7 @@ class MasterServicer:
         lr_staleness_modulation: bool = False,
         staleness_window: int = 0,
         ps_group=None,
+        kv_group=None,
     ):
         # Sharded PS (master/ps_group.py): the dense model lives behind
         # N shard endpoints and workers push slices there directly; the
@@ -77,6 +78,11 @@ class MasterServicer:
         # Public alias: main/tests tear the group down through the
         # servicer, like tb_service.
         self._ps_group = self.ps_group = ps_group
+        # Scale-out embedding service (master/kv_group.py): the tables
+        # live behind N KV shard endpoints; `embedding_store` is then a
+        # ShardedEmbeddingStore client over them, and workers discover
+        # the endpoints via GetPSConfig to hit the shards directly.
+        self._kv_group = self.kv_group = kv_group
         self._lock = threading.Lock()
         self._grads_to_wait = grads_to_wait
         self._opt = optimizer
@@ -383,11 +389,15 @@ class MasterServicer:
                         layer: merge_indexed_rows(irs)
                         for layer, irs in self._edl_grads.items()
                     }
-                    self._apply(avg, merged, aux_state=self._pending_aux)
+                    # clear BEFORE apply: a failed apply raises to the
+                    # reporter (which retries its batch), and leftover
+                    # accumulators would double-count on that retry
+                    aux_pending = self._pending_aux
                     self._pending_aux = None
                     self._grad_sum = None
                     self._grad_n = 0
                     self._edl_grads = {}
+                    self._apply(avg, merged, aux_state=aux_pending)
                     applied = True
             resp = {"accepted": True, "version": self._version}
             if req.get("return_model") and self._version != report_version:
@@ -492,9 +502,11 @@ class MasterServicer:
 
     def get_ps_config(self, req: dict) -> dict:
         """Shard-endpoint discovery for (re)joining workers — a
-        relaunched worker must not depend on argv staying current."""
+        relaunched worker must not depend on argv staying current.
+        Covers BOTH planes: dense PS shards and embedding KV shards."""
+        kv = self._kv_group.endpoints if self._kv_group is not None else []
         if self._ps_group is None:
-            return {"endpoints": [], "n_params": -1}
+            return {"endpoints": [], "n_params": -1, "kv_endpoints": kv}
         with self._lock:
             n = (
                 sum(
@@ -504,7 +516,11 @@ class MasterServicer:
                 if self._params is not None
                 else -1
             )
-        return {"endpoints": self._ps_group.endpoints, "n_params": n}
+        return {
+            "endpoints": self._ps_group.endpoints,
+            "n_params": n,
+            "kv_endpoints": kv,
+        }
 
     def get_aux(self, req: dict) -> dict:
         """Non-trainable state for sharded-mode pull refreshes: shards
@@ -534,6 +550,13 @@ class MasterServicer:
                 self._version = version
             if req.get("aux_state") is not None:
                 self._aux = req["aux_state"]
+            edl_grads = req.get("edl_gradient") or {}
+            if edl_grads and self._sparse_opt is not None:
+                # sharded-PS mode: dense slices rode the shards, the
+                # sparse IndexedRows ride this control-plane report to
+                # the sparse optimizer (whose store may be the KV
+                # shard group)
+                self._sparse_opt.apply_gradients(edl_grads)
             if req.get("want_aux"):
                 # the pusher absorbed merged slices (its base fell
                 # behind) and wants the matching non-trainable state —

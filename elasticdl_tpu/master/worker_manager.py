@@ -119,7 +119,7 @@ class WorkerManager:
     def _event_cb(self, event: PodEvent):
         """Pod phase bookkeeping + recovery
         (reference: k8s_worker_manager.py:110-145)."""
-        if event.replica_type == "ps":
+        if event.replica_type in ("ps", "kv"):
             # shards are job-lifetime services: ANY terminal phase seen
             # while the callback is armed (incl. SUCCEEDED — an exit-0
             # shard is just as dead an endpoint) means the job must
@@ -129,7 +129,8 @@ class WorkerManager:
                 cb = self.on_ps_failure
                 if cb is not None:
                     logger.error(
-                        "PS shard pod %d %s: failing the job",
+                        "%s shard pod %d %s: failing the job",
+                        event.replica_type.upper(),
                         event.worker_id,
                         event.phase,
                     )

@@ -1,0 +1,169 @@
+"""Client for the sharded embedding KV service.
+
+`ShardedEmbeddingStore` implements the EmbeddingStore surface
+(lookup / update / snapshot / restore / __len__) over N shard
+endpoints, so BOTH consumers work unchanged:
+
+- the master's SparseOptimizer applies row/slot updates through it
+  exactly as through the in-process store;
+- workers construct one directly from the endpoints the master
+  advertises (GetPSConfig) and hit the shards WITHOUT the master on
+  the path — the reference's worker->Redis topology
+  (reference: elasticdl/python/worker/worker.py:126-169), which removes
+  the single-endpoint bandwidth wall from the sparse plane the same
+  way `--num_ps` removed it from the dense plane.
+
+Row placement: id -> shard `id % num_shards`, computed here; every
+operation splits its ids by shard and fans out on a thread pool (N
+concurrent RPCs on N sockets, like rpc/ps_client.ShardedPS).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.master.kv_shard import (
+    arrays_to_snapshot,
+    snapshot_to_arrays,
+)
+from elasticdl_tpu.rpc.client import RpcClient
+
+
+class ShardedEmbeddingStore:
+    def __init__(self, endpoints):
+        if not endpoints:
+            raise ValueError("ShardedEmbeddingStore needs >= 1 endpoint")
+        self.endpoints = list(endpoints)
+        self._clients = [RpcClient(ep) for ep in self.endpoints]
+        self._pool = ThreadPoolExecutor(
+            max_workers=len(self.endpoints), thread_name_prefix="kv-shard"
+        )
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._clients)
+
+    def wait_ready(self, timeout: float = 30.0):
+        for c in self._clients:
+            c.wait_ready(timeout)
+
+    def _shard_of(self, ids: np.ndarray) -> np.ndarray:
+        return ids % self.num_shards
+
+    def lookup(self, layer: str, ids) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (values [n, dim], unknown_index into the ORIGINAL order)."""
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        shard = self._shard_of(ids)
+        futs = {}
+        pos = {}
+        for s in range(self.num_shards):
+            (where,) = np.nonzero(shard == s)
+            if not len(where):
+                continue
+            pos[s] = where
+            futs[s] = self._pool.submit(
+                self._clients[s].call,
+                "KVLookup",
+                {"layer": layer, "ids": ids[where]},
+            )
+        values = None
+        unknown_parts = []
+        resps = {s: f.result() for s, f in futs.items()}
+        dim = 0
+        for r in resps.values():
+            v = np.asarray(r["values"])
+            if v.ndim == 2 and v.shape[1] > 0:
+                dim = v.shape[1]
+                break
+        values = np.zeros((len(ids), dim), dtype=np.float32)
+        for s, r in resps.items():
+            v = np.asarray(r["values"])
+            if dim and v.ndim == 2 and v.shape[1] == dim:
+                values[pos[s]] = v
+                unk = np.asarray(r["unknown_index"], dtype=np.int64)
+            else:
+                # shard had no such layer yet: every id there is unknown
+                unk = np.arange(len(pos[s]))
+            if len(unk):
+                unknown_parts.append(pos[s][unk])
+        unknown = (
+            np.sort(np.concatenate(unknown_parts))
+            if unknown_parts
+            else np.empty(0, dtype=np.int64)
+        )
+        return values, unknown
+
+    def update(self, layer: str, ids, values, set_if_not_exist: bool = False):
+        ids = np.asarray(ids, dtype=np.int64).reshape(-1)
+        values = np.asarray(values, dtype=np.float32)
+        shard = self._shard_of(ids)
+        futs = []
+        for s in range(self.num_shards):
+            (where,) = np.nonzero(shard == s)
+            if not len(where):
+                continue
+            futs.append(
+                self._pool.submit(
+                    self._clients[s].call,
+                    "KVUpdate",
+                    {
+                        "layer": layer,
+                        "ids": ids[where],
+                        "values": values[where],
+                        "set_if_not_exist": set_if_not_exist,
+                    },
+                )
+            )
+        for f in futs:
+            f.result()
+
+    def snapshot(self) -> Dict[str, Dict[int, np.ndarray]]:
+        futs = [
+            self._pool.submit(c.call, "KVSnapshot", {})
+            for c in self._clients
+        ]
+        merged: Dict[str, Dict[int, np.ndarray]] = {}
+        for f in futs:
+            part = arrays_to_snapshot(f.result().get("layers") or {})
+            for layer, rows in part.items():
+                merged.setdefault(layer, {}).update(rows)
+        return merged
+
+    def restore(self, snap: Dict[str, Dict[int, np.ndarray]]):
+        # split each layer's rows by the placement hash and fan out
+        parts: list = [dict() for _ in range(self.num_shards)]
+        for layer, rows in (snap or {}).items():
+            for i, row in rows.items():
+                parts[int(i) % self.num_shards].setdefault(layer, {})[
+                    int(i)
+                ] = row
+        futs = []
+        for s, part in enumerate(parts):
+            if not part:
+                continue
+            futs.append(
+                self._pool.submit(
+                    self._clients[s].call,
+                    "KVRestore",
+                    {"layers": snapshot_to_arrays(part)},
+                )
+            )
+        for f in futs:
+            f.result()
+
+    def __len__(self) -> int:
+        return sum(
+            f.result()["n"]
+            for f in [
+                self._pool.submit(c.call, "KVLen", {})
+                for c in self._clients
+            ]
+        )
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+        for c in self._clients:
+            c.close()

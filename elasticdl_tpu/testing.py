@@ -50,6 +50,7 @@ def build_job(
     lr_staleness_modulation: bool = False,
     staleness_window: int = 0,
     checkpoint_filename_for_init: str = "",
+    embedding_store=None,
 ):
     """Wire a MasterServicer + services from a ModelSpec, exactly like
     the real master boot (reference: master/main.py:138-223), including
@@ -67,7 +68,14 @@ def build_job(
 
     store = sparse_opt = None
     if spec.embedding_specs:
-        store = EmbeddingStore()
+        # caller-supplied store (e.g. a ShardedEmbeddingStore over KV
+        # shard endpoints) or the default in-process store. Identity
+        # check, NOT truthiness: stores define __len__, and an EMPTY
+        # sharded store is falsy — `or` would silently swap in a fresh
+        # in-master store and every sparse apply would miss
+        store = (
+            embedding_store if embedding_store is not None else EmbeddingStore()
+        )
         sparse_opt = SparseOptimizer(store, **(spec.sparse_optimizer or {}))
 
     init_params = init_aux = None

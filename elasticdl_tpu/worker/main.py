@@ -55,11 +55,16 @@ def main(argv=None) -> int:
 
     client = RpcClient(args.master_addr)
     client.wait_ready(timeout=60)
-    # sharded-PS discovery: always ask the master (argv can go stale
-    # across elastic relaunches; an empty list = classic single PS)
-    ps_endpoints = client.call("GetPSConfig", {}).get("endpoints") or None
+    # shard discovery: always ask the master (argv can go stale across
+    # elastic relaunches; empty lists = classic single-PS / in-master
+    # embedding store)
+    ps_cfg = client.call("GetPSConfig", {})
+    ps_endpoints = ps_cfg.get("endpoints") or None
+    kv_endpoints = ps_cfg.get("kv_endpoints") or None
     if ps_endpoints:
         logger.info("sharded PS: %d endpoints", len(ps_endpoints))
+    if kv_endpoints:
+        logger.info("embedding KV: %d shards", len(kv_endpoints))
     worker = Worker(
         args.worker_id,
         client,
@@ -69,6 +74,7 @@ def main(argv=None) -> int:
         transport_dtype=args.transport_dtype,
         ps_endpoints=ps_endpoints,
         step_pipeline=args.step_pipeline,
+        kv_endpoints=kv_endpoints,
     )
     # device-level tracing (SURVEY §5.1): a jax.profiler trace of the
     # whole task loop, viewable in TensorBoard/Perfetto/XProf. The

@@ -80,6 +80,7 @@ class Worker:
         seed: int = 0,
         ps_endpoints=None,  # sharded PS (master/ps_shard.py) fan-out
         step_pipeline: int = 0,
+        kv_endpoints=None,  # sharded embedding KV (master/kv_group.py)
     ):
         self._id = worker_id
         self._master = master
@@ -192,11 +193,14 @@ class Worker:
         # (window-deep sparse staleness, the sparse analog of the dense
         # delta). Window=1 is exactly the per-step math.
         self._pending_edl: list = []  # [(BatchEmbeddings, gbets_dev)]
-        if ps_endpoints and model_spec.embedding_specs:
-            raise ValueError(
-                "sharded PS does not support elastic-embedding models "
-                "(mirrors the master-boot check)"
-            )
+        # Scale-out embedding service: rows live behind KV shard
+        # endpoints and this worker reaches them WITHOUT the master on
+        # the path (reference worker->Redis topology, worker.py:126-169)
+        self._kv = None
+        if kv_endpoints:
+            from elasticdl_tpu.rpc.kv_client import ShardedEmbeddingStore
+
+            self._kv = ShardedEmbeddingStore(kv_endpoints)
 
         self._readers = ReaderCache()
         self._train_step = None
@@ -379,6 +383,10 @@ class Worker:
                 "versions": versions,
                 "aux_state": aux_h,
             }
+            if edl_grads:
+                # sparse rows ride the control plane to the master's
+                # sparse optimizer (dense slices already went to shards)
+                meta["edl_gradient"] = edl_grads
             if loss_h is not None:
                 meta["loss"] = float(loss_h)
             self._master.call("ReportWindowMeta", meta)
@@ -439,11 +447,37 @@ class Worker:
 
     # ------------------------------------------------------- embedding plane
 
+    def _emb_lookup(self, layer: str, ids):
+        """Row fetch: straight to the KV shards when the job runs the
+        scale-out embedding service (the reference's worker->Redis
+        topology, worker.py:126-169), via the master otherwise."""
+        if self._kv is not None:
+            return self._kv.lookup(layer, ids)
+        resp = self._master.call(
+            "EmbeddingLookup", {"layer": layer, "ids": ids}
+        )
+        return resp["values"], resp["unknown_index"]
+
+    def _emb_update(self, layer: str, ids, values, set_if_not_exist=False):
+        if self._kv is not None:
+            self._kv.update(
+                layer, ids, values, set_if_not_exist=set_if_not_exist
+            )
+            return
+        self._master.call(
+            "EmbeddingUpdate",
+            {
+                "layer": layer,
+                "ids": ids,
+                "values": values,
+                "set_if_not_exist": set_if_not_exist,
+            },
+        )
+
     def lookup_embedding(self, spec: EmbeddingSpec, ids: np.ndarray) -> np.ndarray:
         """Fetch rows with lazy init of unseen ids
         (reference: worker.py:126-169)."""
-        resp = self._master.call("EmbeddingLookup", {"layer": spec.name, "ids": ids})
-        values, unknown = resp["values"], resp["unknown_index"]
+        values, unknown = self._emb_lookup(spec.name, ids)
         if values.shape[1] == 0:
             values = np.zeros((len(ids), spec.dim), dtype=np.float32)
         else:
@@ -460,21 +494,13 @@ class Worker:
             ).astype(np.float32)
             unknown_ids = np.asarray(ids)[np.asarray(unknown)]
             # SETNX so a concurrent worker's init wins once, globally
-            self._master.call(
-                "EmbeddingUpdate",
-                {
-                    "layer": spec.name,
-                    "ids": unknown_ids,
-                    "values": init,
-                    "set_if_not_exist": True,
-                },
+            self._emb_update(
+                spec.name, unknown_ids, init, set_if_not_exist=True
             )
-            resp2 = self._master.call(
-                "EmbeddingLookup", {"layer": spec.name, "ids": unknown_ids}
-            )
-            if len(resp2["unknown_index"]):
+            values2, unknown2 = self._emb_lookup(spec.name, unknown_ids)
+            if len(unknown2):
                 raise RuntimeError("embedding rows missing after lazy init")
-            values[np.asarray(unknown)] = resp2["values"]
+            values[np.asarray(unknown)] = values2
         return values
 
     def _prepare_embeddings(self, features) -> Dict[str, BatchEmbedding]:
@@ -1062,6 +1088,9 @@ class Worker:
                     # report_local_update response carries aux)
                     "want_aux": bool(merged),
                 }
+                if req.get("edl_gradient"):
+                    # window's sparse rows ride the control plane
+                    meta["edl_gradient"] = req["edl_gradient"]
                 if step_loss_h is not None:
                     meta["loss"] = float(step_loss_h)
                 meta_resp = self._master.call("ReportWindowMeta", meta)
@@ -1864,3 +1893,5 @@ class Worker:
             self._readers.close()
             if self._ps is not None:
                 self._ps.close()
+            if self._kv is not None:
+                self._kv.close()

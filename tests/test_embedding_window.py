@@ -22,10 +22,23 @@ from elasticdl_tpu.worker.worker import Worker
 def _run(tmp_path, tag, local_updates, epochs=2, sync_depth=None):
     import os
 
+    saved = os.environ.get("EDL_SYNC_DEPTH")
     if sync_depth is not None:
         os.environ["EDL_SYNC_DEPTH"] = str(sync_depth)
     else:
         os.environ.pop("EDL_SYNC_DEPTH", None)
+    try:
+        return _run_inner(tmp_path, tag, local_updates, epochs)
+    finally:
+        # never leak the depth into later tests in this process (the
+        # Worker reads it at construction)
+        if saved is None:
+            os.environ.pop("EDL_SYNC_DEPTH", None)
+        else:
+            os.environ["EDL_SYNC_DEPTH"] = saved
+
+
+def _run_inner(tmp_path, tag, local_updates, epochs):
     path = str(tmp_path / f"{tag}.rio")
     rc.write_synthetic_tabular_records(
         path, 32, deepfm_edl_embedding.NUM_FIELDS, 50

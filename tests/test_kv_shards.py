@@ -1,0 +1,255 @@
+"""Scale-out embedding service (VERDICT r3 missing #1): tables behind
+N KV shard endpoints, workers hitting them directly, master sparse
+optimizer + checkpoints through the same store interface.
+
+Reference topology: the Redis-cluster embedding pod
+(elasticdl/python/master/embedding_service.py:82-99, :231-268) with
+workers reading it directly (worker.py:126-169).
+"""
+
+import threading
+
+import numpy as np
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.master.kv_group import KVShardGroup
+from elasticdl_tpu.master.kv_shard import (
+    KVShardServicer,
+    arrays_to_snapshot,
+    snapshot_to_arrays,
+)
+from elasticdl_tpu.master.sparse_optimizer import SparseOptimizer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.models import deepfm_edl_embedding
+from elasticdl_tpu.models import record_codec as rc
+from elasticdl_tpu.rpc.kv_client import ShardedEmbeddingStore
+from elasticdl_tpu.testing import InProcessMaster, build_job
+from elasticdl_tpu.worker.worker import Worker
+
+
+def test_snapshot_wire_roundtrip():
+    snap = {
+        "t": {1: np.arange(4, dtype=np.float32), 9: np.ones(4, np.float32)}
+    }
+    back = arrays_to_snapshot(snapshot_to_arrays(snap))
+    assert set(back["t"]) == {1, 9}
+    np.testing.assert_array_equal(back["t"][1], snap["t"][1])
+
+
+def _group(n=3):
+    g = KVShardGroup(n, mode="inproc")
+    g.start()
+    return g
+
+
+def test_sharded_store_lookup_update_roundtrip():
+    g = _group(3)
+    try:
+        store = ShardedEmbeddingStore(g.endpoints)
+        ids = np.array([0, 1, 2, 5, 7, 300, 301], dtype=np.int64)
+        # all unknown at first
+        values, unknown = store.lookup("t", ids)
+        assert len(unknown) == len(ids)
+        rows = np.arange(len(ids) * 4, dtype=np.float32).reshape(-1, 4)
+        store.update("t", ids, rows)
+        values, unknown = store.lookup("t", ids)
+        assert len(unknown) == 0
+        np.testing.assert_allclose(values, rows)
+        # order-independence: a permuted query returns permuted rows
+        perm = np.array([301, 5, 0], dtype=np.int64)
+        v2, unk2 = store.lookup("t", perm)
+        assert len(unk2) == 0
+        np.testing.assert_allclose(v2[1], rows[3])
+        assert len(store) == len(ids)
+        store.close()
+    finally:
+        g.stop()
+
+
+def test_sharded_store_setnx_race():
+    """Two concurrent initializers SETNX the same ids with different
+    values: exactly one wins per id, globally across shards."""
+    g = _group(2)
+    try:
+        store = ShardedEmbeddingStore(g.endpoints)
+        ids = np.arange(1, 33, dtype=np.int64)
+        a = np.full((len(ids), 4), 1.0, np.float32)
+        b = np.full((len(ids), 4), 2.0, np.float32)
+
+        def put(vals):
+            store.update("t", ids, vals, set_if_not_exist=True)
+
+        t1 = threading.Thread(target=put, args=(a,))
+        t2 = threading.Thread(target=put, args=(b,))
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        values, unknown = store.lookup("t", ids)
+        assert len(unknown) == 0
+        # each row is entirely 1.0 or entirely 2.0 — never a mix
+        for row in values:
+            assert np.all(row == row[0]) and row[0] in (1.0, 2.0)
+        store.close()
+    finally:
+        g.stop()
+
+
+def test_sharded_store_snapshot_restore():
+    g = _group(3)
+    try:
+        store = ShardedEmbeddingStore(g.endpoints)
+        ids = np.array([2, 3, 4, 10, 11], dtype=np.int64)
+        rows = np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32)
+        store.update("t", ids, rows)
+        snap = store.snapshot()
+        assert set(snap["t"]) == set(ids.tolist())
+        store.close()
+    finally:
+        g.stop()
+    # restore into a FRESH group (the resume path)
+    g2 = _group(2)  # different shard count: placement must re-hash
+    try:
+        store2 = ShardedEmbeddingStore(g2.endpoints)
+        store2.restore(snap)
+        values, unknown = store2.lookup("t", ids)
+        assert len(unknown) == 0
+        np.testing.assert_allclose(values, rows, atol=1e-6)
+        store2.close()
+    finally:
+        g2.stop()
+
+
+def test_sparse_optimizer_through_kv_shards():
+    """The master's SparseOptimizer (rows + adam slots) works unchanged
+    against the sharded store."""
+    from elasticdl_tpu.common.codec import IndexedRows
+
+    g = _group(2)
+    try:
+        store = ShardedEmbeddingStore(g.endpoints)
+        opt = SparseOptimizer(store, kind="adam", learning_rate=0.1)
+        ids = np.array([1, 2, 3], dtype=np.int64)
+        store.update("t", ids, np.zeros((3, 4), np.float32))
+        opt.apply_gradients(
+            {"t": IndexedRows(values=np.ones((3, 4), np.float32), indices=ids)}
+        )
+        values, unknown = store.lookup("t", ids)
+        assert len(unknown) == 0
+        assert np.all(values < 0)  # rows moved against the gradient
+        snap = store.snapshot()
+        assert "t/slot/m" in snap and "t/slot/v" in snap
+        store.close()
+    finally:
+        g.stop()
+
+
+def _run_deepfm(
+    tmp_path, tag, kv_group=None, ps_group=None, local_updates=0,
+    use_async=False,
+):
+    path = str(tmp_path / f"{tag}.rio")
+    rc.write_synthetic_tabular_records(
+        path, 32, deepfm_edl_embedding.NUM_FIELDS, 50
+    )
+    dispatcher = TaskDispatcher({path: 32}, {}, {}, 8, 2, shuffle_seed=7)
+    spec = spec_from_module(deepfm_edl_embedding)
+    store = ShardedEmbeddingStore(kv_group.endpoints) if kv_group else None
+    servicer, _evs, _ckpt = build_job(
+        spec,
+        dispatcher,
+        grads_to_wait=1,
+        embedding_store=store,
+        use_async=use_async,
+    )
+    if ps_group is not None:
+        servicer._ps_group = servicer.ps_group = ps_group
+    worker = Worker(
+        0,
+        InProcessMaster(servicer),
+        spec,
+        minibatch_size=8,
+        local_updates=local_updates,
+        ps_endpoints=ps_group.endpoints if ps_group else None,
+        kv_endpoints=kv_group.endpoints if kv_group else None,
+    )
+    assert worker.run()
+    worker.close()
+    assert dispatcher.finished()
+    return servicer
+
+
+def test_deepfm_job_through_kv_shards(tmp_path):
+    """Full job: worker looks rows up DIRECTLY from the shards, sparse
+    grads applied master-side through the sharded store."""
+    g = _group(2)
+    try:
+        servicer = _run_deepfm(tmp_path, "kv", kv_group=g)
+        snap = servicer._embedding_store.snapshot()
+        assert snap["fm_second"] and "fm_second/slot/m" in snap
+        assert 0 not in snap["fm_second"]  # mask_zero never learns
+    finally:
+        g.stop()
+
+
+def test_deepfm_window_mode_with_kv_and_sharded_ps(tmp_path):
+    """The full composition: dense slices on PS shards, rows on KV
+    shards, sparse IndexedRows riding ReportWindowMeta."""
+    from elasticdl_tpu.master.ps_group import PSShardGroup
+
+    kv = _group(2)
+    ps = PSShardGroup(
+        2, mode="inproc", optimizer_factory=deepfm_edl_embedding.optimizer
+    )
+    ps.start()
+    try:
+        servicer = _run_deepfm(
+            tmp_path, "kv-ps", kv_group=kv, ps_group=ps, local_updates=2
+        )
+        snap = servicer._embedding_store.snapshot()
+        assert snap["fm_second"] and "fm_second/slot/m" in snap
+        versions, vec = ps.assemble()
+        assert min(versions) > 0 and vec is not None
+    finally:
+        ps.stop()
+        kv.stop()
+
+
+def test_process_mode_kv_group():
+    """Real subprocess shards, ephemeral ports via port files."""
+    g = KVShardGroup(2, mode="process", boot_timeout=120)
+    g.start()
+    try:
+        store = ShardedEmbeddingStore(g.endpoints)
+        store.wait_ready(60)
+        ids = np.array([4, 9], dtype=np.int64)
+        store.update("t", ids, np.ones((2, 3), np.float32))
+        values, unknown = store.lookup("t", ids)
+        assert len(unknown) == 0
+        np.testing.assert_allclose(values, 1.0)
+        store.close()
+    finally:
+        g.stop()
+
+
+def test_deepfm_per_step_with_kv_and_sharded_ps(tmp_path):
+    """Per-step sharded composition: dense grads fan out to async PS
+    shards, sparse IndexedRows ride the per-step ReportWindowMeta."""
+    from elasticdl_tpu.master.ps_group import PSShardGroup
+
+    kv = _group(2)
+    ps = PSShardGroup(
+        2,
+        mode="inproc",
+        optimizer_factory=deepfm_edl_embedding.optimizer,
+        use_async=True,
+    )
+    ps.start()
+    try:
+        servicer = _run_deepfm(
+            tmp_path, "kv-ps-step", kv_group=kv, ps_group=ps,
+            local_updates=0, use_async=True,
+        )
+        snap = servicer._embedding_store.snapshot()
+        assert snap["fm_second"] and "fm_second/slot/m" in snap
+    finally:
+        ps.stop()
+        kv.stop()
