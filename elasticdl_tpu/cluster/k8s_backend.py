@@ -443,11 +443,7 @@ class K8sBackend(PodBackend):
         logger.info("Created worker pod %s", pod["metadata"]["name"])
 
     def delete_worker(self, worker_id: int):
-        name = worker_pod_name(self._job_name, worker_id)
-        try:
-            self._core.delete_namespaced_pod(name, self._namespace)
-        except Exception:
-            logger.warning("delete pod %s failed:\n%s", name, traceback.format_exc())
+        self._delete_pod(worker_pod_name(self._job_name, worker_id))
 
     def _create_shard_pod(
         self, build_fn, shard_id: int, module: str, argv, port: int

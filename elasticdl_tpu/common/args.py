@@ -148,6 +148,12 @@ def add_master_args(parser: argparse.ArgumentParser):
         help="write train-loss + eval-metric summaries here "
         "(torch SummaryWriter when available, JSONL fallback)",
     )
+    parser.add_argument(
+        "--keep_tensorboard_running", action="store_true",
+        help="after the job completes, keep the master alive serving "
+        "TensorBoard until its process dies or the pod is deleted "
+        "(reference master/main.py:311-324)",
+    )
     # elasticity / cluster
     parser.add_argument("--num_workers", type=pos_int, default=1)
     parser.add_argument(

@@ -87,44 +87,15 @@ class KVShardGroup:
             self.endpoints.append(f"localhost:{server.port}")
 
     def _start_process(self):
-        tmp = tempfile.mkdtemp(prefix="edl_kv_")
-        port_files = []
-        for i in range(self._n):
-            port_file = os.path.join(tmp, f"kv-{i}.port")
-            port_files.append(port_file)
-            argv = [
-                sys.executable,
-                "-m",
-                "elasticdl_tpu.master.kv_shard_main",
-                "--port", "0",
-                "--port_file", port_file,
-                "--shard_id", str(i),
-                "--num_shards", str(self._n),
-            ]
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"  # row storage never needs a chip
-            import elasticdl_tpu
+        from elasticdl_tpu.master.shard_host import spawn_shard_processes
 
-            pkg_root = os.path.dirname(os.path.dirname(elasticdl_tpu.__file__))
-            env["PYTHONPATH"] = (
-                pkg_root + os.pathsep + env["PYTHONPATH"]
-                if env.get("PYTHONPATH")
-                else pkg_root
-            )
-            self._procs.append(subprocess.Popen(argv, env=env))
-        deadline = time.time() + self._boot_timeout
-        for i, pf in enumerate(port_files):
-            while not os.path.exists(pf):
-                if self._procs[i].poll() is not None:
-                    raise RuntimeError(
-                        f"KV shard {i} exited rc={self._procs[i].returncode} "
-                        "before publishing its port"
-                    )
-                if time.time() > deadline:
-                    raise TimeoutError(f"KV shard {i} did not publish a port")
-                time.sleep(0.05)
-            with open(pf) as f:
-                self.endpoints.append(f"localhost:{int(f.read().strip())}")
+        self._procs, self.endpoints = spawn_shard_processes(
+            self._n,
+            "elasticdl_tpu.master.kv_shard_main",
+            lambda i: ["--shard_id", str(i), "--num_shards", str(self._n)],
+            "edl_kv_",
+            self._boot_timeout,
+        )
 
     def store(self) -> ShardedEmbeddingStore:
         """The master's store client (SparseOptimizer + checkpoints)."""
@@ -145,13 +116,8 @@ class KVShardGroup:
         for i in range(self._k8s_created):
             self._k8s_backend.delete_kv_shard(i)
         self._k8s_created = 0
-        for p in self._procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in self._procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        from elasticdl_tpu.master.shard_host import stop_shard_processes
+
+        stop_shard_processes(self._procs)
         self._procs = []
         self.endpoints = []

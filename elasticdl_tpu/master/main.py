@@ -223,10 +223,12 @@ def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
     # training jobs
     init_params = init_aux = None
     init_version = 0
+    ckpt_opt_state = None
     if args.checkpoint_filename_for_init:
         model = load_model_file(args.checkpoint_filename_for_init)
         init_params, init_aux = model.params, model.aux
         init_version = model.version
+        ckpt_opt_state = getattr(model, "opt_state", None)
         if store is not None and model.embeddings:
             store.restore(model.embeddings)
         logger.info(
@@ -255,9 +257,29 @@ def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
         include_evaluation=with_eval,
         embedding_store=store,
     )
+    ps_opt = PSOptimizer(spec.optimizer())
+    if init_params is not None and ckpt_opt_state:
+        kind = ckpt_opt_state.get("kind")
+        if kind == "single" and ps_group is None:
+            # exact resume: the dense optimizer continues its
+            # checkpointed momentum/Adam moments instead of cold-starting
+            ps_opt.restore_state(init_params, ckpt_opt_state["leaves"])
+            logger.info("Restored dense optimizer state from the checkpoint")
+        elif kind == "single":
+            logger.warning(
+                "checkpoint has single-PS optimizer state but this job "
+                "runs --num_ps shards: shard optimizers start COLD "
+                "(resume is not exact)"
+            )
+        elif kind == "sharded" and ps_group is None:
+            logger.warning(
+                "checkpoint has sharded optimizer state but this job "
+                "runs a single PS: the optimizer starts COLD "
+                "(resume is not exact)"
+            )
     servicer = MasterServicer(
         grads_to_wait=args.grads_to_wait,
-        optimizer=PSOptimizer(spec.optimizer()),
+        optimizer=ps_opt,
         task_dispatcher=dispatcher,
         checkpoint_service=ckpt,
         embedding_store=store,
@@ -275,6 +297,9 @@ def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
         from elasticdl_tpu.common import codec
 
         ps_group.ensure_init(codec.ravel_np(init_params), init_version)
+        if ckpt_opt_state and ckpt_opt_state.get("kind") == "sharded":
+            ps_group.restore_opt(ckpt_opt_state["shards"])
+            logger.info("Restored per-shard optimizer state (exact resume)")
     tb_service = None
     if getattr(args, "tensorboard_log_dir", ""):
         from elasticdl_tpu.master.tensorboard_service import TensorBoardService
@@ -449,14 +474,26 @@ def main(argv=None) -> int:
         ckpt.close()  # queued async checkpoint writes must land
         if eval_service is not None:
             eval_service.stop()
-        if servicer.tb_service is not None:
-            servicer.tb_service.close()
+        # shard pods/processes and the watch free BEFORE any
+        # TensorBoard keep-alive: serving summaries needs none of them,
+        # and keep_running can block for days
         if servicer.ps_group is not None:
             servicer.ps_group.stop()
         if servicer.kv_group is not None:
             servicer.kv_group.stop()
         backend.stop()
         server.stop()
+        if servicer.tb_service is not None:
+            if (
+                exit_code == 0
+                and getattr(args, "keep_tensorboard_running", False)
+                and servicer.tb_service.is_active()
+            ):
+                # reference master/main.py:311-324: the job is done but
+                # the master stays up serving TensorBoard until the
+                # tensorboard process dies / the pod is deleted
+                servicer.tb_service.keep_running()
+            servicer.tb_service.close()
     return exit_code
 
 

@@ -161,47 +161,15 @@ class PSShardGroup:
             self.endpoints.append(f"localhost:{server.port}")
 
     def _start_process(self):
-        tmp = tempfile.mkdtemp(prefix="edl_ps_")
-        port_files = []
-        for i in range(self._n):
-            port_file = os.path.join(tmp, f"shard-{i}.port")
-            port_files.append(port_file)
-            argv = [
-                sys.executable,
-                "-m",
-                "elasticdl_tpu.master.ps_shard_main",
-                "--port", "0",
-                "--port_file", port_file,
-            ] + self._shard_cli_flags(i)
-            env = dict(os.environ)
-            # PS math is host math: never let a shard grab the TPU
-            # (ps_shard_main also pins the backend itself — the image's
-            # sitecustomize overrides the env var)
-            env["JAX_PLATFORMS"] = "cpu"
-            import elasticdl_tpu
+        from elasticdl_tpu.master.shard_host import spawn_shard_processes
 
-            pkg_root = os.path.dirname(
-                os.path.dirname(elasticdl_tpu.__file__)
-            )
-            env["PYTHONPATH"] = (
-                pkg_root + os.pathsep + env["PYTHONPATH"]
-                if env.get("PYTHONPATH")
-                else pkg_root
-            )
-            self._procs.append(subprocess.Popen(argv, env=env))
-        deadline = time.time() + self._boot_timeout
-        for i, pf in enumerate(port_files):
-            while not os.path.exists(pf):
-                if self._procs[i].poll() is not None:
-                    raise RuntimeError(
-                        f"PS shard {i} exited rc={self._procs[i].returncode} "
-                        "before publishing its port"
-                    )
-                if time.time() > deadline:
-                    raise TimeoutError(f"PS shard {i} did not publish a port")
-                time.sleep(0.05)
-            with open(pf) as f:
-                self.endpoints.append(f"localhost:{int(f.read().strip())}")
+        self._procs, self.endpoints = spawn_shard_processes(
+            self._n,
+            "elasticdl_tpu.master.ps_shard_main",
+            self._shard_cli_flags,
+            "edl_ps_",
+            self._boot_timeout,
+        )
 
     def stop(self):
         if self._client is not None:
@@ -215,14 +183,9 @@ class PSShardGroup:
         for i in range(self._k8s_created):
             self._k8s_backend.delete_ps_shard(i)
         self._k8s_created = 0
-        for p in self._procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in self._procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        from elasticdl_tpu.master.shard_host import stop_shard_processes
+
+        stop_shard_processes(self._procs)
         self._procs = []
         self.endpoints = []
 
@@ -245,6 +208,18 @@ class PSShardGroup:
         """Idempotent model init (shard-side SETNX)."""
         vec = np.asarray(vec, dtype=np.float32)
         return self.client(vec.size).init_model(vec, version)
+
+    def export_opt(self):
+        """Per-shard optimizer-state leaves for checkpoints."""
+        if self._client is None:
+            return None
+        return self._client.export_opt()
+
+    def restore_opt(self, shards):
+        """Adopt checkpointed per-shard optimizer state (after
+        ensure_init). Requires the same shard count as the
+        checkpointing job — slices don't re-split."""
+        self.client().restore_opt(shards)
 
     def assemble(self, model_dtype: Optional[str] = None):
         """(shard_versions, full_flat_vec) — the master's view for

@@ -9,7 +9,7 @@ and cheap serialization, not TPU FLOPs (SURVEY §7.1).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -62,3 +62,32 @@ class PSOptimizer:
         with jax.default_device(_cpu_device()):
             new_params, self._state = self._apply(params, grads, self._state)
         return jax.tree_util.tree_map(np.asarray, new_params)
+
+    # -- exact resume (VERDICT r3 #8) ----------------------------------------
+    # Optax states are nested NamedTuples, which the wire codec does
+    # not preserve; checkpoints carry the flat LEAVES only and the
+    # structure is rebuilt from a fresh init at restore time.
+
+    def state_snapshot(self) -> Optional[list]:
+        """Flat numpy leaves of the optax state (None if never run)."""
+        if self._state is None:
+            return None
+        return [
+            np.asarray(leaf)
+            for leaf in jax.tree_util.tree_leaves(self._state)
+        ]
+
+    def restore_state(self, params: Any, leaves: list):
+        """Adopt checkpointed state: momentum/Adam moments continue the
+        interrupted trajectory exactly instead of restarting cold."""
+        self.initialize(params)
+        treedef = jax.tree_util.tree_structure(self._state)
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"optimizer state mismatch: checkpoint has {len(leaves)} "
+                f"leaves, the optimizer needs {treedef.num_leaves} "
+                "(different optimizer or model than the checkpoint's)"
+            )
+        self._state = jax.tree_util.tree_unflatten(
+            treedef, [np.asarray(leaf) for leaf in leaves]
+        )

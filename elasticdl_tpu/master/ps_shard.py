@@ -105,7 +105,28 @@ class PSShardServicer:
             "PSPull": self.pull,
             "PSPushGrad": self.push_grad,
             "PSPushDelta": self.push_delta,
+            "PSOptState": self.opt_state,
+            "PSOptRestore": self.opt_restore,
         }
+
+    def opt_state(self, req: dict) -> dict:
+        """Flat optimizer-state leaves of this slice (exact resume)."""
+        with self._lock:
+            leaves = (
+                self._opt.state_snapshot()
+                if self._opt is not None and self._opt.initialized
+                else None
+            )
+        return {"leaves": leaves}
+
+    def opt_restore(self, req: dict) -> dict:
+        """Adopt checkpointed optimizer state for this slice."""
+        with self._lock:
+            if self._vec is None:
+                raise ValueError("opt restore before slice init")
+            if self._opt is not None and req.get("leaves") is not None:
+                self._opt.restore_state(self._vec, req["leaves"])
+        return {}
 
     @property
     def version(self) -> int:

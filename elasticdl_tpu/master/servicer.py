@@ -84,6 +84,13 @@ class MasterServicer:
         # the endpoints via GetPSConfig to hit the shards directly.
         self._kv_group = self.kv_group = kv_group
         self._lock = threading.Lock()
+        # Sparse applies serialize among THEMSELVES (read-modify-write
+        # per id) but run OUTSIDE self._lock: with a KV-shard-backed
+        # store every apply is several RPC fan-outs, and holding the
+        # global lock across them would serialize the whole control
+        # plane behind network round-trips. Each handler applies before
+        # returning, so a worker still reads its own writes.
+        self._sparse_lock = threading.Lock()
         self._grads_to_wait = grads_to_wait
         self._opt = optimizer
         self._task_d = task_dispatcher
@@ -335,6 +342,7 @@ class MasterServicer:
         applied = False
         applied_version = -1
         ckpt_snapshot = None
+        sparse_to_apply = None
         with self._lock:
             if self._params is None:
                 raise ValueError("gradient reported before model init")
@@ -362,8 +370,9 @@ class MasterServicer:
                 if self._lr_staleness_modulation and staleness > 1:
                     # doc/async_sgd_design.md:75-82
                     scale = 1.0 / float(staleness)
-                self._apply(grads, edl_grads, dense_scale=scale, aux_state=aux_state)
+                self._apply(grads, {}, dense_scale=scale, aux_state=aux_state)
                 applied = True
+                sparse_to_apply = edl_grads
             else:
                 # sync accumulate
                 if self._grad_sum is None:
@@ -397,8 +406,9 @@ class MasterServicer:
                     self._grad_sum = None
                     self._grad_n = 0
                     self._edl_grads = {}
-                    self._apply(avg, merged, aux_state=aux_pending)
+                    self._apply(avg, {}, aux_state=aux_pending)
                     applied = True
+                    sparse_to_apply = merged
             resp = {"accepted": True, "version": self._version}
             if req.get("return_model") and self._version != report_version:
                 # a step was applied (by this report or a concurrent
@@ -417,7 +427,9 @@ class MasterServicer:
                     ckpt_snapshot = (
                         jax.tree_util.tree_map(np.copy, self._params),
                         jax.tree_util.tree_map(np.copy, self._aux),
+                        self._opt_state_snapshot(),
                     )
+        self._apply_sparse(sparse_to_apply)
         if applied:
             # hooks run OUTSIDE the lock: the eval service calls back
             # into get_params_copy and must not deadlock
@@ -473,13 +485,6 @@ class MasterServicer:
                 self._params,
                 delta,
             )
-            edl_grads = req.get("edl_gradient") or {}
-            if edl_grads and self._sparse_opt is not None:
-                # the window's accumulated BET gradients: applied at
-                # full weight like the per-step path (_apply never
-                # scales sparse grads — the slot state, not an LR
-                # damper, governs sparse staleness)
-                self._sparse_opt.apply_gradients(edl_grads)
             if aux_state is not None:
                 self._aux = aux_state
             self._version += steps
@@ -490,12 +495,18 @@ class MasterServicer:
                 ckpt_snapshot = (
                     jax.tree_util.tree_map(np.copy, self._params),
                     jax.tree_util.tree_map(np.copy, self._aux),
+                    self._opt_state_snapshot(),
                 )
             resp = {"version": self._version}
             # base fell behind (concurrent syncs): return the merged model
             if base_version + steps != self._version or req.get("want_model"):
                 resp["params_flat"] = self._flat_model(req.get("model_dtype"))
                 resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
+        # the window's accumulated BET gradients: applied at full
+        # weight like the per-step path (the slot state, not an LR
+        # damper, governs sparse staleness); outside the lock — see
+        # _apply_sparse
+        self._apply_sparse(req.get("edl_gradient") or {})
         self._on_version_bump(applied_version, ckpt_snapshot, prev_version)
         self._report_train_loss(applied_version, req.get("loss"))
         return resp
@@ -550,13 +561,6 @@ class MasterServicer:
                 self._version = version
             if req.get("aux_state") is not None:
                 self._aux = req["aux_state"]
-            edl_grads = req.get("edl_gradient") or {}
-            if edl_grads and self._sparse_opt is not None:
-                # sharded-PS mode: dense slices rode the shards, the
-                # sparse IndexedRows ride this control-plane report to
-                # the sparse optimizer (whose store may be the KV
-                # shard group)
-                self._sparse_opt.apply_gradients(edl_grads)
             if req.get("want_aux"):
                 # the pusher absorbed merged slices (its base fell
                 # behind) and wants the matching non-trainable state —
@@ -570,9 +574,13 @@ class MasterServicer:
                 # assembled AFTER the crossing report: a relaxed
                 # snapshot at >= the crossing version (ps_shard.py)
                 params, aux, v = self.get_params_copy()
-                ckpt_snapshot = (params, aux)
+                ckpt_snapshot = (params, aux, None)
                 version = max(version, v)
             self._on_version_bump(version, ckpt_snapshot, prev)
+        # sharded-PS mode: dense slices rode the shards; the sparse
+        # IndexedRows ride this control-plane report — applied outside
+        # the lock (see _apply_sparse)
+        self._apply_sparse(req.get("edl_gradient") or {})
         # every applied report carries a real loss even when its min
         # shard version trails the mirror (other workers ran ahead) —
         # gating on `advanced` would undercount the metrics sink in
@@ -588,6 +596,13 @@ class MasterServicer:
         if model_dtype and model_dtype != "float32":
             vec = vec.astype(codec.dtype_from_str(model_dtype))
         return vec
+
+    def _apply_sparse(self, edl_grads):
+        """Apply IndexedRows to the (possibly RPC-backed) store —
+        callers invoke AFTER releasing self._lock, BEFORE returning."""
+        if edl_grads and self._sparse_opt is not None:
+            with self._sparse_lock:
+                self._sparse_opt.apply_gradients(edl_grads)
 
     def _validate(self, grads):
         """Shape sanity checks (reference: servicer.py:320-370)."""
@@ -634,6 +649,15 @@ class MasterServicer:
             except Exception:  # a metrics sink must never fail training
                 logger.exception("train-loss hook failed")
 
+    def _opt_state_snapshot(self):
+        """Dense optimizer state for exact resume (taken under the
+        lock with the matching params copy). None before the first
+        apply or in sharded mode (shards own their slices' state —
+        save_latest_checkpoint assembles those explicitly)."""
+        if self._opt is None or not self._opt.initialized:
+            return None
+        return {"kind": "single", "leaves": self._opt.state_snapshot()}
+
     def _on_version_bump(self, version: int, ckpt_snapshot=None, prev_version=None):
         """Checkpoint/eval hooks for an applied version. Caller must NOT
         hold the lock (reference fires these inside its mutex,
@@ -642,8 +666,10 @@ class MasterServicer:
         exactly `version`. Cadence checks are floor-crossing so
         multi-step bumps (local-update syncs) can't skip triggers."""
         if ckpt_snapshot is not None and self._checkpoint_service:
-            params, aux = ckpt_snapshot
-            self._checkpoint_service.save(params, version, aux=aux)
+            params, aux, opt_state = ckpt_snapshot
+            self._checkpoint_service.save(
+                params, version, aux=aux, opt_state=opt_state
+            )
         if self._evaluation_service:
             self._evaluation_service.add_evaluation_task_if_needed(
                 version, prev_version
@@ -698,8 +724,19 @@ class MasterServicer:
         )
         if self._ps_group is not None:
             params, aux, version = self.get_params_copy()
+            shard_states = self._ps_group.export_opt()
+            opt_state = (
+                {"kind": "sharded", "shards": shard_states}
+                if shard_states is not None
+                else None
+            )
             save_model_file(
-                output_path, params, version, aux=aux, embeddings=emb
+                output_path,
+                params,
+                version,
+                aux=aux,
+                embeddings=emb,
+                opt_state=opt_state,
             )
             return
         with self._lock:
@@ -709,4 +746,5 @@ class MasterServicer:
                 self._version,
                 aux=self._aux,
                 embeddings=emb,
+                opt_state=self._opt_state_snapshot(),
             )

@@ -1,0 +1,87 @@
+"""Shared shard-hosting machinery for the PS and KV shard groups.
+
+Both groups (`ps_group.PSShardGroup`, `kv_group.KVShardGroup`) own N
+job-lifetime service endpoints with identical lifecycles — inproc
+RpcServers, subprocesses with port-file discovery, or k8s pods — and
+differ only in the entry module, the servicer, and the pod builder.
+The lifecycle lives HERE so a fix (port-file polling, partial-boot pod
+cleanup, terminate/kill teardown) cannot drift between the two.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, List, Tuple
+
+
+def spawn_shard_processes(
+    n: int,
+    entry_module: str,
+    flags_fn: Callable[[int], List[str]],
+    prefix: str,
+    boot_timeout: float,
+) -> Tuple[List[subprocess.Popen], List[str]]:
+    """Boot N shard subprocesses of `entry_module`; each binds an
+    ephemeral port and publishes it through --port_file (no bind
+    races). Returns (procs, endpoints); on failure the already-spawned
+    processes are the caller's to stop (its stop() handles them)."""
+    tmp = tempfile.mkdtemp(prefix=prefix)
+    procs: List[subprocess.Popen] = []
+    port_files = []
+    for i in range(n):
+        port_file = os.path.join(tmp, f"shard-{i}.port")
+        port_files.append(port_file)
+        argv = [
+            sys.executable,
+            "-m",
+            entry_module,
+            "--port", "0",
+            "--port_file", port_file,
+        ] + flags_fn(i)
+        env = dict(os.environ)
+        # shard math/storage is host-side: never let a shard grab the
+        # accelerator (the entrypoints also pin the backend themselves —
+        # the image's sitecustomize overrides the env var)
+        env["JAX_PLATFORMS"] = "cpu"
+        import elasticdl_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(elasticdl_tpu.__file__))
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_root
+        )
+        procs.append(subprocess.Popen(argv, env=env))
+    endpoints = []
+    deadline = time.time() + boot_timeout
+    for i, pf in enumerate(port_files):
+        while not os.path.exists(pf):
+            if procs[i].poll() is not None:
+                raise RuntimeError(
+                    f"shard {i} ({entry_module}) exited "
+                    f"rc={procs[i].returncode} before publishing its port"
+                )
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"shard {i} ({entry_module}) did not publish a port"
+                )
+            time.sleep(0.05)
+        with open(pf) as f:
+            endpoints.append(f"localhost:{int(f.read().strip())}")
+    return procs, endpoints
+
+
+def stop_shard_processes(procs: List[subprocess.Popen]):
+    """Terminate, grace-wait, then kill."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()

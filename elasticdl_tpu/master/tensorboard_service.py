@@ -126,6 +126,27 @@ class TensorBoardService:
             logger.warning("tensorboard process unavailable; summaries on disk")
             return False
 
+    def is_active(self) -> bool:
+        """True while the spawned tensorboard process is running
+        (reference: tensorboard_service.py is_active)."""
+        return self._tb_proc is not None and self._tb_proc.poll() is None
+
+    def keep_running(self, poll_secs: float = 10.0):
+        """Block until the tensorboard process exits — the reference's
+        post-job behavior (master/main.py:311-324): the job is done but
+        the master pod stays up serving summaries until someone kills
+        the process/pod."""
+        if not self.is_active():
+            logger.warning(
+                "Unable to keep TensorBoard running. "
+                "It has already terminated"
+            )
+            return
+        logger.info("Job finished; keeping TensorBoard running...")
+        while self.is_active():
+            time.sleep(poll_secs)
+        logger.info("TensorBoard process ended; master exiting")
+
     def close(self):
         self._writer.flush()
         self._writer.close()

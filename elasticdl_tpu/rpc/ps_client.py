@@ -212,6 +212,27 @@ class ShardedPS:
             vec = self._assemble([r["vec"] for r in resps])
         return new_versions, vec
 
+    def export_opt(self) -> List[Optional[list]]:
+        """Per-shard optimizer-state leaves (exact resume)."""
+        return [
+            r["leaves"]
+            for r in self._map(
+                lambda c, i: c.call("PSOptState", {}), idempotent=True
+            )
+        ]
+
+    def restore_opt(self, shards: List[Optional[list]]):
+        if len(shards) != self.num_shards:
+            raise ValueError(
+                f"opt state has {len(shards)} shards, group has "
+                f"{self.num_shards} — exact resume needs the same "
+                "--num_ps as the checkpointing job"
+            )
+        self._map(
+            lambda c, i: c.call("PSOptRestore", {"leaves": shards[i]}),
+            idempotent=True,  # restore overwrites; a resend is a no-op
+        )
+
     def _assemble(self, slices: List[np.ndarray]) -> np.ndarray:
         out = np.empty(self.n_params, dtype=np.asarray(slices[0]).dtype)
         for (s, e), sl in zip(self.bounds, slices):

@@ -80,10 +80,12 @@ def build_job(
 
     init_params = init_aux = None
     init_version = 0
+    ckpt_opt_state = None
     if checkpoint_filename_for_init:
         model = load_model_file(checkpoint_filename_for_init)
         init_params, init_aux = model.params, model.aux
         init_version = model.version
+        ckpt_opt_state = getattr(model, "opt_state", None)
         if store is not None and model.embeddings:
             store.restore(model.embeddings)
 
@@ -94,9 +96,16 @@ def build_job(
         include_evaluation=bool(eval_steps),
         embedding_store=store,
     )
+    ps_opt = PSOptimizer(spec.optimizer())
+    if (
+        init_params is not None
+        and ckpt_opt_state
+        and ckpt_opt_state.get("kind") == "single"
+    ):
+        ps_opt.restore_state(init_params, ckpt_opt_state["leaves"])
     servicer = MasterServicer(
         grads_to_wait=grads_to_wait,
-        optimizer=PSOptimizer(spec.optimizer()),
+        optimizer=ps_opt,
         task_dispatcher=dispatcher,
         checkpoint_service=ckpt,
         embedding_store=store,

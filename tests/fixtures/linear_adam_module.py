@@ -1,0 +1,17 @@
+"""Linear fixture with a STATEFUL optimizer (adam): momentum/moments
+make exact-resume assertions meaningful — with stateless sgd, a resume
+that silently dropped optimizer state would still be bit-equal."""
+
+import optax
+
+from tests.fixtures.linear_module import (  # noqa: F401 (re-exports)
+    Linear,
+    custom_model,
+    dataset_fn,
+    eval_metrics_fn,
+    loss,
+)
+
+
+def optimizer():
+    return optax.adam(0.05)

@@ -97,9 +97,9 @@ def test_async_writer_does_not_block_save(tmp_path, monkeypatch):
     real_save = ckpt_mod.save_model_file
     delay = 0.3
 
-    def slow_save(path, params, version, aux=None, embeddings=None):
+    def slow_save(path, params, version, aux=None, embeddings=None, **kw):
         time.sleep(delay)
-        real_save(path, params, version, aux=aux, embeddings=embeddings)
+        real_save(path, params, version, aux=aux, embeddings=embeddings, **kw)
 
     monkeypatch.setattr(ckpt_mod, "save_model_file", slow_save)
     service = CheckpointService(

@@ -1,0 +1,150 @@
+"""Exact resume (VERDICT r3 #8): checkpoints carry the dense
+optimizer's optax state (and per-shard states in sharded-PS mode), so
+a resumed job continues the EXACT trajectory of an uninterrupted one —
+asserted bit-for-bit with adam, whose moments make any silent
+state-drop visible (closes the slot-state analog of
+doc/distributed_embedding_layer_design.md:425-428; sparse slot rows
+already ride the embeddings snapshot).
+
+One task per epoch pins the batch order: the split run's epochs see
+the same record sequence as the uninterrupted run's.
+"""
+
+import numpy as np
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.common import codec
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.testing import (
+    InProcessMaster,
+    build_job,
+    write_linear_records,
+)
+from elasticdl_tpu.worker.worker import Worker
+
+from tests.fixtures import linear_adam_module
+
+N = 32
+MB = 16
+
+
+def _run(path, epochs, ckpt_init="", ps_group=None):
+    # one task per epoch: batch order is the read order, epoch-invariant
+    dispatcher = TaskDispatcher({path: N}, {}, {}, N, epochs)
+    spec = spec_from_module(linear_adam_module)
+    servicer, _evs, _ckpt = build_job(
+        spec,
+        dispatcher,
+        grads_to_wait=1,
+        checkpoint_filename_for_init=ckpt_init,
+    )
+    if ps_group is not None:
+        servicer._ps_group = servicer.ps_group = ps_group
+        if ckpt_init:
+            from elasticdl_tpu.master.checkpoint import load_model_file
+
+            m = load_model_file(ckpt_init)
+            ps_group.ensure_init(codec.ravel_np(m.params), m.version)
+            opt = getattr(m, "opt_state", None)
+            if opt and opt.get("kind") == "sharded":
+                ps_group.restore_opt(opt["shards"])
+    worker = Worker(
+        0,
+        InProcessMaster(servicer),
+        spec,
+        minibatch_size=MB,
+        ps_endpoints=ps_group.endpoints if ps_group else None,
+    )
+    assert worker.run()
+    worker.close()
+    assert dispatcher.finished()
+    params, _aux, version = servicer.get_params_copy()
+    return servicer, codec.ravel_np(params), version
+
+
+def test_single_ps_resume_is_bit_exact(tmp_path):
+    path = str(tmp_path / "train.rio")
+    write_linear_records(path, N, noise=0.05)
+
+    # uninterrupted: 4 epochs straight
+    _s, full_vec, full_v = _run(path, 4)
+
+    # interrupted: 2 epochs, checkpoint (params + adam moments), resume
+    s1, _vec1, v1 = _run(path, 2)
+    ckpt = str(tmp_path / "mid.ckpt")
+    s1.save_latest_checkpoint(ckpt)
+    _s2, resumed_vec, resumed_v = _run(path, 2, ckpt_init=ckpt)
+
+    assert resumed_v == full_v == v1 * 2
+    np.testing.assert_array_equal(resumed_vec, full_vec)  # BIT-equal
+
+
+def test_resume_without_opt_state_diverges(tmp_path):
+    """Guard against a vacuous pass: dropping the optimizer state from
+    the checkpoint must produce a DIFFERENT trajectory (cold adam
+    moments), proving the bit-equality above is earned by the state."""
+    from elasticdl_tpu.master.checkpoint import load_model_file, save_model_file
+
+    path = str(tmp_path / "train.rio")
+    write_linear_records(path, N, noise=0.05)
+    _s, full_vec, _fv = _run(path, 4)
+    s1, _vec1, _v1 = _run(path, 2)
+    ckpt = str(tmp_path / "mid.ckpt")
+    s1.save_latest_checkpoint(ckpt)
+    m = load_model_file(ckpt)
+    stripped = str(tmp_path / "stripped.ckpt")
+    save_model_file(stripped, m.params, m.version, aux=m.aux)  # no opt_state
+    _s2, cold_vec, _rv = _run(path, 2, ckpt_init=stripped)
+    assert not np.allclose(cold_vec, full_vec, atol=1e-7)
+
+
+def test_sharded_ps_resume_is_bit_exact(tmp_path):
+    from elasticdl_tpu.master.ps_group import PSShardGroup
+
+    path = str(tmp_path / "train.rio")
+    write_linear_records(path, N, noise=0.05)
+
+    def group():
+        g = PSShardGroup(
+            2,
+            mode="inproc",
+            optimizer_factory=linear_adam_module.optimizer,
+            use_async=True,
+        )
+        g.start()
+        return g
+
+    g_full = group()
+    try:
+        _s, full_vec, full_v = _run(path, 4, ps_group=g_full)
+    finally:
+        g_full.stop()
+
+    g1 = group()
+    try:
+        s1, _vec, _v = _run(path, 2, ps_group=g1)
+        ckpt = str(tmp_path / "shard_mid.ckpt")
+        s1.save_latest_checkpoint(ckpt)
+    finally:
+        g1.stop()
+
+    g2 = group()
+    try:
+        _s2, resumed_vec, resumed_v = _run(path, 2, ckpt_init=ckpt, ps_group=g2)
+    finally:
+        g2.stop()
+    assert resumed_v == full_v
+    np.testing.assert_array_equal(resumed_vec, full_vec)
+
+
+def test_shard_count_mismatch_rejected(tmp_path):
+    """A checkpoint's per-shard opt state only fits the same --num_ps."""
+    import pytest
+
+    from elasticdl_tpu.rpc.ps_client import ShardedPS
+
+    ps = ShardedPS.__new__(ShardedPS)
+    ps.endpoints = ["a", "b", "c"]
+    ps._clients = [None] * 3
+    with pytest.raises(ValueError, match="same --num_ps"):
+        ps.restore_opt([None, None])

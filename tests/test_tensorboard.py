@@ -76,3 +76,33 @@ def test_training_job_writes_summaries(tmp_path):
     events = glob.glob(os.path.join(logdir, "events*"))
     assert events, f"no event files under {logdir}"
     assert sum(os.path.getsize(p) for p in events) > 0
+
+
+def test_keep_running_until_tb_process_exits(tmp_path):
+    """--keep_tensorboard_running semantics (reference
+    master/main.py:311-324): the master blocks while the tensorboard
+    process lives, returns when it dies."""
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    from elasticdl_tpu.master.tensorboard_service import TensorBoardService
+
+    svc = TensorBoardService(str(tmp_path / "tb"))
+    assert not svc.is_active()  # no process: keep_running returns at once
+    svc.keep_running(poll_secs=0.01)
+    # stand in a long-lived child for the tensorboard process
+    svc._tb_proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+    assert svc.is_active()
+    done = threading.Event()
+    t = threading.Thread(
+        target=lambda: (svc.keep_running(poll_secs=0.05), done.set())
+    )
+    t.start()
+    time.sleep(0.15)
+    assert not done.is_set()  # still blocking while the process lives
+    svc._tb_proc.terminate()
+    t.join(10)
+    assert done.is_set()
+    svc.close()
