@@ -298,8 +298,19 @@ def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
 
         ps_group.ensure_init(codec.ravel_np(init_params), init_version)
         if ckpt_opt_state and ckpt_opt_state.get("kind") == "sharded":
-            ps_group.restore_opt(ckpt_opt_state["shards"])
-            logger.info("Restored per-shard optimizer state (exact resume)")
+            try:
+                ps_group.restore_opt(ckpt_opt_state["shards"])
+                logger.info(
+                    "Restored per-shard optimizer state (exact resume)"
+                )
+            except ValueError as e:
+                # a resized job must still resume (params re-split
+                # fine); only the optimizer moments start cold — same
+                # degradation as the other topology mismatches
+                logger.warning(
+                    "optimizer state not restored (%s): shard "
+                    "optimizers start COLD (resume is not exact)", e,
+                )
     tb_service = None
     if getattr(args, "tensorboard_log_dir", ""):
         from elasticdl_tpu.master.tensorboard_service import TensorBoardService

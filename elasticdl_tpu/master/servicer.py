@@ -370,7 +370,7 @@ class MasterServicer:
                 if self._lr_staleness_modulation and staleness > 1:
                     # doc/async_sgd_design.md:75-82
                     scale = 1.0 / float(staleness)
-                self._apply(grads, {}, dense_scale=scale, aux_state=aux_state)
+                self._apply(grads, dense_scale=scale, aux_state=aux_state)
                 applied = True
                 sparse_to_apply = edl_grads
             else:
@@ -406,7 +406,7 @@ class MasterServicer:
                     self._grad_sum = None
                     self._grad_n = 0
                     self._edl_grads = {}
-                    self._apply(avg, {}, aux_state=aux_pending)
+                    self._apply(avg, aux_state=aux_pending)
                     applied = True
                     sparse_to_apply = merged
             resp = {"accepted": True, "version": self._version}
@@ -566,6 +566,12 @@ class MasterServicer:
                 # behind) and wants the matching non-trainable state —
                 # mirrors the aux piggyback on report_local_update
                 resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
+        # sharded-PS mode: dense slices rode the shards; the sparse
+        # IndexedRows ride this control-plane report — applied outside
+        # the lock (see _apply_sparse), and BEFORE the version-bump
+        # hooks so a cadence checkpoint's embedding snapshot includes
+        # this very report's rows
+        self._apply_sparse(req.get("edl_gradient") or {})
         if advanced:
             ckpt_snapshot = None
             if self._checkpoint_service and self._checkpoint_service.crossed(
@@ -577,10 +583,6 @@ class MasterServicer:
                 ckpt_snapshot = (params, aux, None)
                 version = max(version, v)
             self._on_version_bump(version, ckpt_snapshot, prev)
-        # sharded-PS mode: dense slices rode the shards; the sparse
-        # IndexedRows ride this control-plane report — applied outside
-        # the lock (see _apply_sparse)
-        self._apply_sparse(req.get("edl_gradient") or {})
         # every applied report carries a real loss even when its min
         # shard version trails the mirror (other workers ran ahead) —
         # gating on `advanced` would undercount the metrics sink in
@@ -619,14 +621,15 @@ class MasterServicer:
                     f"{np.asarray(p).shape}"
                 )
 
-    def _apply(self, dense_grads, edl_grads, dense_scale: float = 1.0, aux_state=None):
-        """Optimizer step + version bump + hooks (caller holds the lock;
+    def _apply(self, dense_grads, dense_scale: float = 1.0, aux_state=None):
+        """DENSE optimizer step + version bump (caller holds the lock;
         reference: servicer.py:169-229, 398-402). Non-trainable state
-        (BN moving stats) is last-writer-wins from the reporting hosts."""
+        (BN moving stats) is last-writer-wins from the reporting hosts.
+        Sparse grads go through _apply_sparse OUTSIDE the lock — never
+        here (the RPC-backed store must not serialize the control
+        plane, and _sparse_lock owns that serialization)."""
         if aux_state is not None:
             self._aux = aux_state
-        if edl_grads and self._sparse_opt is not None:
-            self._sparse_opt.apply_gradients(edl_grads)
         if dense_grads is not None and self._opt is not None:
             if dense_scale != 1.0:
                 dense_grads = jax.tree_util.tree_map(

@@ -27,8 +27,9 @@ def spawn_shard_processes(
 ) -> Tuple[List[subprocess.Popen], List[str]]:
     """Boot N shard subprocesses of `entry_module`; each binds an
     ephemeral port and publishes it through --port_file (no bind
-    races). Returns (procs, endpoints); on failure the already-spawned
-    processes are the caller's to stop (its stop() handles them)."""
+    races). Returns (procs, endpoints). A boot failure reaps every
+    already-spawned process BEFORE raising — the caller's procs list
+    is only assigned on success, so its stop() could never see them."""
     tmp = tempfile.mkdtemp(prefix=prefix)
     procs: List[subprocess.Popen] = []
     port_files = []
@@ -58,20 +59,24 @@ def spawn_shard_processes(
         procs.append(subprocess.Popen(argv, env=env))
     endpoints = []
     deadline = time.time() + boot_timeout
-    for i, pf in enumerate(port_files):
-        while not os.path.exists(pf):
-            if procs[i].poll() is not None:
-                raise RuntimeError(
-                    f"shard {i} ({entry_module}) exited "
-                    f"rc={procs[i].returncode} before publishing its port"
-                )
-            if time.time() > deadline:
-                raise TimeoutError(
-                    f"shard {i} ({entry_module}) did not publish a port"
-                )
-            time.sleep(0.05)
-        with open(pf) as f:
-            endpoints.append(f"localhost:{int(f.read().strip())}")
+    try:
+        for i, pf in enumerate(port_files):
+            while not os.path.exists(pf):
+                if procs[i].poll() is not None:
+                    raise RuntimeError(
+                        f"shard {i} ({entry_module}) exited "
+                        f"rc={procs[i].returncode} before publishing its port"
+                    )
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"shard {i} ({entry_module}) did not publish a port"
+                    )
+                time.sleep(0.05)
+            with open(pf) as f:
+                endpoints.append(f"localhost:{int(f.read().strip())}")
+    except Exception:
+        stop_shard_processes(procs)
+        raise
     return procs, endpoints
 
 

@@ -291,18 +291,22 @@ def plain_forward(cfg: TransformerConfig, params: Dict, tokens: jnp.ndarray):
     collectives) — the value is (a) a mesh-free entry point for simple
     callers (the model-zoo adapter), (b) compile time flat in depth
     where reference_forward's Python unroll grows linearly (measured
-    1.5s vs 3.9s at 24 layers), (c) the flash-kernel hook. Dense FFN
-    only — MoE keeps the shard_map path, whose dispatch einsums ARE
-    its vectorization. Casts params to cfg.dtype itself."""
+    1.5s vs 3.9s at 24 layers), (c) the flash-kernel hook. MoE layers
+    use the capacity-bounded einsum dispatch with every expert local
+    (parallel/moe.moe_ffn_local — same routing math as the
+    expert-parallel path, no collectives). Casts params to cfg.dtype
+    itself. Returns (logits, aux): aux is the summed Switch
+    load-balance loss (0 for dense)."""
     from elasticdl_tpu.ops.flash_attention import attention
+    from elasticdl_tpu.parallel.moe import moe_ffn_local
 
-    assert not cfg.n_experts, "plain_forward is the dense fast path"
     params = jax.tree_util.tree_map(lambda a: a.astype(cfg.dtype), params)
     b, l = tokens.shape
     h = params["embed"][tokens]  # [B, L, d]
     positions = jnp.arange(l)
 
-    def body(h, lp):
+    def body(carry, lp):
+        h, aux = carry
         x = rms_norm(h, lp["ln1"])
         q = (x @ lp["wq"]).reshape(b, l, cfg.n_heads, cfg.head_dim)
         k = (x @ lp["wk"]).reshape(b, l, cfg.n_heads, cfg.head_dim)
@@ -311,29 +315,46 @@ def plain_forward(cfg: TransformerConfig, params: Dict, tokens: jnp.ndarray):
         attn = attention(q, k, v, causal=True).reshape(b, l, -1)
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["ln2"])
-        h = h + jax.nn.gelu(x @ lp["w1"]) @ lp["w2"]
-        return h, None
+        if cfg.n_experts:
+            out, a = moe_ffn_local(
+                x.reshape(b * l, cfg.d_model),
+                lp["router"],
+                lp["ew1"],
+                lp["ew2"],
+                capacity_factor=cfg.capacity_factor,
+            )
+            h = h + out.reshape(b, l, cfg.d_model)
+            aux = aux + a
+        else:
+            h = h + jax.nn.gelu(x @ lp["w1"]) @ lp["w2"]
+        return (h, aux), None
 
     if cfg.remat:
         body = jax.checkpoint(body)
 
-    h, _ = lax.scan(body, h, params["layers"])
+    (h, aux), _ = lax.scan(
+        body, (h, jnp.zeros((), dtype=h.dtype)), params["layers"]
+    )
     h = rms_norm(h, params["ln_f"])
-    return h @ params["head"]
+    return h @ params["head"], aux
 
 
 def build_loss_fn(cfg: TransformerConfig, mesh: Mesh):
     """Returns loss(params, tokens) — tokens [B, L+1]; jit-able with
-    params/data sharded over `mesh`. A single-device mesh with a dense
-    FFN takes the plain_forward fast path (identical math, no
-    shard_map scaffolding)."""
+    params/data sharded over `mesh`. A single-device mesh takes the
+    plain_forward fast path (identical math, no shard_map scaffolding);
+    MoE included — the local einsum dispatch stands in for the
+    all_to_all one."""
     from jax import shard_map
 
-    if mesh.size == 1 and not cfg.n_experts:
+    if mesh.size == 1:
 
         def plain_loss(params, tokens):
-            logits = plain_forward(cfg, params, tokens[:, :-1])
-            return token_cross_entropy(logits, tokens[:, 1:])
+            logits, aux = plain_forward(cfg, params, tokens[:, :-1])
+            loss = token_cross_entropy(logits, tokens[:, 1:])
+            if cfg.n_experts:
+                loss = loss + cfg.aux_weight * aux.astype(jnp.float32)
+            return loss
 
         return plain_loss
 

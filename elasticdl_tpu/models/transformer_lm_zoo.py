@@ -28,7 +28,6 @@ from elasticdl_tpu.models.transformer_lm import (
     TransformerConfig,
     init_params,
     plain_forward,
-    reference_forward,
     token_cross_entropy,
 )
 
@@ -46,11 +45,14 @@ class TransformerLM:
         return {"params": params}
 
     def apply(self, variables, tokens):
-        # dense: the vectorized scan-over-layers fast path; MoE falls
-        # back to the (test-oriented) reference loop
-        if self.cfg.n_experts:
-            return reference_forward(self.cfg, variables["params"], tokens)
-        return plain_forward(self.cfg, variables["params"], tokens)
+        # the vectorized scan-over-layers fast path for dense AND MoE
+        # (capacity-bounded einsum dispatch, parallel/moe.moe_ffn_local).
+        # The Switch aux loss is dropped here: the zoo spec contract is
+        # loss(outputs, labels), so only the LM loss reaches the PS —
+        # router balance regularization lives in the mesh path
+        # (build_loss_fn), which serious MoE training drives.
+        logits, _aux = plain_forward(self.cfg, variables["params"], tokens)
+        return logits
 
 
 def custom_model(**model_params):

@@ -25,6 +25,61 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _route(
+    x: jnp.ndarray, router_w: jnp.ndarray, num_experts: int, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-1 capacity-bounded routing — ONE definition shared by the
+    expert-parallel and single-host paths.
+    -> (dispatch [T,E,C], combine [T,E,C], scalar Switch aux loss)."""
+    # routing numerics are f32/int32 REGARDLESS of the activation
+    # dtype: a bf16 cumsum over thousands of tokens loses integer
+    # exactness above 256, silently corrupting slot assignment (and
+    # the f32 softmax keeps the gate/aux statistics well-conditioned)
+    logits = (x @ router_w).astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = jnp.max(probs, axis=-1)  # [T] f32
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    onehot_i = jax.nn.one_hot(expert, num_experts, dtype=jnp.int32)  # [T, E]
+
+    # Switch aux loss: E * Σ_e (token fraction)·(mean router prob)
+    frac = jnp.mean(onehot_i.astype(jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = (num_experts * jnp.sum(frac * mean_prob)).astype(x.dtype)
+
+    # position of each token within its expert's send buffer
+    pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - 1  # [T, E], -1 if not routed
+    keep = (pos >= 0) & (pos < capacity)  # [T, E]
+    slot = jnp.sum(jnp.where(keep, pos, 0), axis=-1).astype(jnp.int32)  # [T]
+    slot_onehot = jax.nn.one_hot(slot, capacity, dtype=x.dtype)  # [T, C]
+    # keep (routed AND under capacity) gates the whole row: dropped
+    # tokens dispatch nowhere and combine to zero
+    dispatch = keep.astype(x.dtype)[:, :, None] * slot_onehot[:, None, :]  # [T,E,C]
+    combine = dispatch * gate.astype(x.dtype)[:, None, None]  # [T, E, C]
+    return dispatch, combine, aux
+
+
+def moe_ffn_local(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    w1: jnp.ndarray,
+    w2: jnp.ndarray,
+    capacity_factor: float = 2.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-host fast path: the same capacity-bounded einsum dispatch
+    with every expert local — no collectives, no mesh, jit-plain
+    (VERDICT r3 #6: the zoo/PS runtime path must not fall back to the
+    per-token reference loop). x: [T, d]; w1: [E, d, f]; w2: [E, f, d].
+    -> ([T, d] output, scalar Switch aux loss)."""
+    e, d, _f = w1.shape
+    capacity = max(1, math.ceil(x.shape[0] * capacity_factor / e))
+    dispatch, combine, aux = _route(x, router_w, e, capacity)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, d]
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w1))
+    ye = jnp.einsum("ecf,efd->ecd", h, w2)
+    out = jnp.einsum("tec,ecd->td", combine, ye)
+    return out, aux
+
+
 def moe_ffn(
     x: jnp.ndarray,
     router_w: jnp.ndarray,
@@ -44,27 +99,7 @@ def moe_ffn(
     # per-(source-rank, expert) slots; every rank sends ≤ C tokens to
     # each expert, keeping the all_to_all block static-shaped
     capacity = max(1, math.ceil(t * capacity_factor / num_experts))
-
-    logits = x @ router_w  # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    gate = jnp.max(probs, axis=-1)  # [T]
-    expert = jnp.argmax(probs, axis=-1)  # [T]
-    onehot = jax.nn.one_hot(expert, num_experts, dtype=x.dtype)  # [T, E]
-
-    # Switch aux loss: E * Σ_e (token fraction)·(mean router prob)
-    frac = jnp.mean(onehot, axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
-    aux = num_experts * jnp.sum(frac * mean_prob)
-
-    # position of each token within its expert's send buffer
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # [T, E], -1 if not routed
-    keep = (pos >= 0) & (pos < capacity)  # [T, E]
-    slot = jnp.sum(jnp.where(keep, pos, 0.0), axis=-1).astype(jnp.int32)  # [T]
-    slot_onehot = jax.nn.one_hot(slot, capacity, dtype=x.dtype)  # [T, C]
-    # keep (routed AND under capacity) gates the whole row: dropped
-    # tokens dispatch nowhere and combine to zero
-    dispatch = keep.astype(x.dtype)[:, :, None] * slot_onehot[:, None, :]  # [T,E,C]
-    combine = dispatch * gate[:, None, None]  # [T, E, C]
+    dispatch, combine, aux = _route(x, router_w, num_experts, capacity)
 
     xe = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, d]
     xe = xe.reshape(ep, e_local, capacity, d)

@@ -142,7 +142,7 @@ def test_plain_fast_path_matches_reference():
 
     from elasticdl_tpu.models.transformer_lm import reference_forward
 
-    logits_fast = np.asarray(plain_forward(DENSE_CFG, params, tokens[:, :-1]))
+    logits_fast = np.asarray(plain_forward(DENSE_CFG, params, tokens[:, :-1])[0])
     logits_ref = np.asarray(reference_forward(DENSE_CFG, params, tokens[:, :-1]))
     np.testing.assert_allclose(logits_fast, logits_ref, atol=2e-4)
 
@@ -188,13 +188,38 @@ def test_remat_matches_non_remat():
     assert abs(float(sharded(p, tokens)) - float(base(params, tokens))) < 2e-4
 
 
-def test_moe_single_device_keeps_shard_map_path():
+def test_moe_single_device_takes_plain_fast_path():
+    """VERDICT r3 #6: MoE no longer falls back to the per-token
+    reference loop off-mesh — the 1-device path is the vectorized
+    capacity-bounded einsum dispatch, same math as the shard_map path
+    and (CE term) as the dense reference loop."""
     mesh1 = _mesh((1, 1, 1, 1))
     fn = build_loss_fn(MOE_CFG, mesh1)
-    assert fn.__name__ != "plain_loss"
+    assert fn.__name__ == "plain_loss"
     rng = np.random.default_rng(0)
     params = init_params(rng, MOE_CFG)
     tokens = _tokens(rng, b=2, l=8)
-    sharded = float(fn(place_params(params, MOE_CFG, mesh1), tokens))
+    fast = float(fn(params, tokens))
+    # reference_loss has no aux term: compare CE-only via plain_forward
+    from elasticdl_tpu.models.transformer_lm import (
+        plain_forward,
+        token_cross_entropy,
+    )
+
+    logits, _aux = plain_forward(MOE_CFG, params, tokens[:, :-1])
+    ce = float(token_cross_entropy(logits, tokens[:, 1:]))
     dense = float(reference_loss(MOE_CFG, params, tokens))
-    assert abs(sharded - dense) < 2e-4
+    assert abs(ce - dense) < 2e-4  # routing/expert math matches the loop
+    # the full fast loss adds the Switch aux regularizer
+    assert fast >= ce - 1e-6
+
+    # and it matches the shard_map path on a multi-device MoE mesh
+    # (b=4: with dp=2 and n_micro=2 each microbatch still has a row)
+    tokens4 = _tokens(rng, b=4, l=8)
+    fast4 = float(fn(params, tokens4))
+    mesh = _mesh((1, 2, 1, 1))
+    sharded_fn = build_loss_fn(MOE_CFG, mesh)
+    sharded = float(
+        sharded_fn(place_params(params, MOE_CFG, mesh), tokens4)
+    )
+    assert abs(sharded - fast4) < 2e-3

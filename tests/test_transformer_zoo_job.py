@@ -89,3 +89,39 @@ def test_transformer_window_mode_job(tmp_path):
     assert rc == 0
     final = _final_loss(output, data)
     assert final < 0.5 * math.log(VOCAB), f"loss {final:.3f} did not fall"
+
+
+def test_transformer_moe_zoo_job_fast_path(tmp_path):
+    """MoE trains through the PS runtime on the vectorized
+    capacity-bounded dispatch (VERDICT r3 #6 — the adapter no longer
+    falls back to the per-token reference loop; moe_ffn_local raising
+    here would fail the job). In-process harness: the subprocess boot
+    cost belongs to the e2e tier."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.testing import InProcessMaster, build_job
+    from elasticdl_tpu.worker.worker import Worker
+
+    data = os.path.join(str(tmp_path), "tokens.rio")
+    write_learnable_token_records(data, 256, SEQ, VOCAB)
+    dispatcher = TaskDispatcher({data: 256}, {}, {}, 128, 3)
+    lm = zoo.custom_model(vocab=VOCAB, n_experts=2)
+    assert lm.cfg.n_experts == 2
+    spec = spec_from_module(zoo, model=lm)
+    servicer, _evs, _ckpt = build_job(spec, dispatcher, grads_to_wait=1)
+    worker = Worker(0, InProcessMaster(servicer), spec, minibatch_size=32)
+    assert worker.run()
+    worker.close()
+    assert dispatcher.finished()
+    params, _aux, _v = servicer.get_params_copy()
+    # converged well below chance on the deterministic sequences
+    from elasticdl_tpu.data.recordio import RecordIOReader
+
+    with RecordIOReader(data) as r:
+        records = list(r.read_range(0, 64))
+    feats, labels = zoo.dataset_fn(records, "training")
+    outputs = lm.apply({"params": params}, jnp.asarray(feats))
+    final = float(zoo.loss(outputs, jnp.asarray(labels)))
+    assert final < 0.5 * math.log(VOCAB), f"loss {final:.3f} did not fall"
