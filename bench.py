@@ -332,6 +332,28 @@ def main():
         file=sys.stderr,
     )
 
+    # ---- north-star model: ResNet-50 chip throughput ----
+    # (bench_resnet.py holds the full story incl. the elastic-runtime
+    # number and the link physics; the chip number rides the driver's
+    # JSON record here)
+    resnet = None
+    if on_tpu:
+        from bench_resnet import chip_throughput
+
+        r_ips, r_tf, r_mfu, _rl = chip_throughput(
+            res=224, batch=64, steps=16, reps=3
+        )
+        resnet = {
+            "images_per_sec_chip_224": round(r_ips, 1),
+            "tflops_per_sec": round(r_tf, 2),
+            "mfu_vs_v5e_bf16_peak": round(r_mfu, 4),
+        }
+        print(
+            f"bench[resnet50 chip]: {r_ips:.1f} img/s @224 = "
+            f"{r_tf:.1f} TFLOP/s = {100 * r_mfu:.1f}% MFU",
+            file=sys.stderr,
+        )
+
     print(
         json.dumps(
             {
@@ -344,6 +366,7 @@ def main():
                 "deepfm_sparse_window_records_per_sec": round(
                     dfm_recs_per_sec, 1
                 ),
+                "resnet50_chip": resnet,
                 "window_runs_images_per_sec": [
                     round(a[0], 1) for a in attempts
                 ],

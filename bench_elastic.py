@@ -49,6 +49,10 @@ KILL_FRACTION = 0.5
 KILL_WAVES = int(os.environ.get("EDL_ELASTIC_BENCH_WAVES", 3))
 KILL_FIRST, KILL_LAST = 0.25, 0.75
 SEEDS = int(os.environ.get("EDL_ELASTIC_BENCH_SEEDS", 2))
+# standalone continuation: run seeds [BASE, BASE+SEEDS) — lets a
+# truncated multi-seed session finish its remaining seeds in a second
+# invocation with identical data/protocol
+SEED_BASE = int(os.environ.get("EDL_ELASTIC_BENCH_SEED_BASE", 0))
 MINIBATCH = 64
 RECORDS_PER_TASK = 512  # = one full 8-step window per task (no ragged
 # tails -> exactly one compiled program per worker)
@@ -174,8 +178,14 @@ def run_job(
         for wid in victims:
             pid = backend.pid_of(wid)
             if pid:
-                os.kill(pid, signal.SIGKILL)
-                n += 1
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                    n += 1
+                except ProcessLookupError:
+                    # victim died on its own between pid_of and the
+                    # kill: count one fewer rather than aborting a
+                    # multi-hour multi-seed run
+                    pass
         return n, len(alive)
 
     try:
@@ -273,7 +283,7 @@ def main():
     BOOT_AMORTIZATION = float(os.environ.get("EDL_ELASTIC_BENCH_AMORT", "12"))
 
     per_seed = []
-    for seed in range(SEEDS):
+    for seed in range(SEED_BASE, SEED_BASE + SEEDS):
         tmp = tempfile.mkdtemp(prefix=f"edl_elastic_bench_s{seed}_")
         _write_data(tmp, n_records, seed=seed)
         print(

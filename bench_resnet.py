@@ -116,7 +116,7 @@ def chip_throughput(res=224, batch=64, steps=16, reps=4, num_classes=1000):
     return best, tflops, tflops / 197.0, loss0
 
 
-def runtime_throughput(window=32, minibatch=128, n_records=16384):
+def runtime_throughput(window=32, minibatch=128, n_records=32768):
     """ResNet-50 through the elastic PS runtime (window mode, bf16
     transport, BN aux riding the sync) on synthetic 64x64 RecordIO."""
     from bench import run_job
@@ -193,8 +193,10 @@ def main():
 
     rt_ips, rt_mfu, rt_tail = runtime_throughput(
         window=32 if on_tpu else 2,
+        # 8 whole-window tasks: with only 4, end-of-job wait_poll and
+        # the final sync tail were ~30% of the measured window
         minibatch=128 if on_tpu else 16,
-        n_records=16384 if on_tpu else 64,
+        n_records=32768 if on_tpu else 64,
     )
     if on_tpu and rt_tail is not None:
         assert rt_tail < 2.0, f"runtime run diverged: tail {rt_tail:.3f}"
