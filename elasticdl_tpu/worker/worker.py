@@ -100,6 +100,9 @@ class Worker:
         # draws) must not become per-op round-trips to a remote device
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
             self._rng = jax.random.PRNGKey(seed + worker_id)
+        # host-side generator for embedding lazy-init draws (see
+        # lookup_embedding for why this is not jax.random)
+        self._emb_init_rng = np.random.default_rng(seed + worker_id)
 
         self._params = None  # trainable pytree (device)
         self._aux: Dict[str, Any] = {}  # non-trainable collections
@@ -483,14 +486,17 @@ class Worker:
         else:
             values = np.array(values)  # decoded buffers are read-only views
         if len(unknown):
-            self._rng, sub = jax.random.split(self._rng)
-            init = np.asarray(
-                jax.random.uniform(
-                    sub,
-                    (len(unknown), spec.dim),
-                    minval=-spec.init_scale,
-                    maxval=spec.init_scale,
-                )
+            # numpy, NOT jax.random: the draw is a host-side eager op
+            # on the sparse HOT path, and jax would (a) run it on the
+            # default — possibly remote-tunneled — device (~2s/batch
+            # measured through the axon tunnel) and (b) recompile for
+            # every distinct unknown-count shape (~1s/batch on CPU).
+            # Lazy-init values just need per-worker determinism, which
+            # the seeded generator provides.
+            init = self._emb_init_rng.uniform(
+                -spec.init_scale,
+                spec.init_scale,
+                size=(len(unknown), spec.dim),
             ).astype(np.float32)
             unknown_ids = np.asarray(ids)[np.asarray(unknown)]
             # SETNX so a concurrent worker's init wins once, globally
