@@ -392,7 +392,14 @@ def main():
                     "grad-up/model-down round per minibatch); the "
                     "pipeline hides it behind compute — on a co-located "
                     "TPU-VM the same path pays microseconds of PCIe/ICI "
-                    "latency per round instead"
+                    "latency per round instead. The deepfm number is "
+                    "the elastic-embedding sparse plane through window "
+                    "mode (per-batch BET lookups, accumulated "
+                    "IndexedRows riding each delta sync); resnet50_chip "
+                    "is the north-star model's device-resident full "
+                    "train step (see bench_resnet.py for the "
+                    "elastic-runtime variant and the input-bandwidth "
+                    "physics)"
                 ),
             }
         )
