@@ -91,7 +91,11 @@ def run_job(
     time_limit: float = 0.0,
 ):
     from elasticdl_tpu.cluster.pod_backend import ProcessBackend
-    from elasticdl_tpu.common.args import master_parser, worker_forward_args
+    from elasticdl_tpu.common.args import (
+        master_parser,
+        resolve_compile_cache_envs,
+        worker_forward_args,
+    )
     from elasticdl_tpu.master.main import (
         build_master,
         make_sample_batch_fn,
@@ -111,6 +115,7 @@ def run_job(
             "--local_updates", str(LOCAL_UPDATES),
             "--num_workers", str(N_WORKERS),
             "--worker_backend", "process",
+            "--compile_cache_dir", cache_dir,
         ]
     )
     spec, dispatcher, servicer, _, _ = build_master(args, "training")
@@ -127,15 +132,9 @@ def run_job(
         worker_argv_fn=lambda wid: worker_forward_args(args, wid, addr),
         envs={
             "JAX_PLATFORMS": "cpu",
-            **(
-                {
-                    "JAX_COMPILATION_CACHE_DIR": cache_dir,
-                    # cache every program regardless of compile time
-                    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
-                }
-                if cache_dir
-                else {}
-            ),
+            # the framework's --compile_cache_dir feature: replacements
+            # and standbys reuse the incumbents' compiled programs
+            **resolve_compile_cache_envs(args),
         },
         max_relaunches=2 * N_WORKERS,
         num_standby=standby,
@@ -261,14 +260,12 @@ def main():
     epochs = int(
         os.environ.get("EDL_ELASTIC_BENCH_EPOCHS", 1 if small_host else 2)
     )
-    # Fast worker recovery via a persistent XLA compile cache
-    # (JAX_COMPILATION_CACHE_DIR) is how production deployments make a
-    # relaunched replacement restart in seconds instead of re-paying
-    # the jit compile. Opt-in (EDL_ELASTIC_BENCH_CACHE=1): on this
-    # image the XLA:CPU AOT reload path is slower than recompiling, so
-    # by default the retention number honestly includes the full
-    # recompile cost of each relaunched worker.
-    cache_dir = ""
+    # Fast worker recovery via the framework's --compile_cache_dir
+    # (default on, shared per seed so the stable and churn runs see the
+    # same cache state): a relaunched replacement reuses the
+    # incumbents' compiled programs instead of re-paying the XLA
+    # compile. EDL_ELASTIC_BENCH_CACHE=0 measures the cold-boot path.
+    use_cache = os.environ.get("EDL_ELASTIC_BENCH_CACHE", "1") == "1"
     # Warm standbys (--num_standby_workers) are the framework's answer
     # to the relaunch transient: a pre-booted, AOT-compiled spare is
     # promoted the moment an active worker dies, so recovery costs one
@@ -293,20 +290,7 @@ def main():
             f"{int(KILL_FIRST * 100)}% and {int(KILL_LAST * 100)}%",
             file=sys.stderr,
         )
-        if os.environ.get("EDL_ELASTIC_BENCH_CACHE") == "1" and not cache_dir:
-            cache_dir = os.path.join(tmp, "xla-cache")
-            warm_dir = os.path.join(tmp, "warm")
-            os.makedirs(warm_dir)
-            _write_data(warm_dir, 4 * RECORDS_PER_TASK)
-            t0 = time.time()
-            run_job(
-                warm_dir, 4 * RECORDS_PER_TASK, churn=False, epochs=1,
-                cache_dir=cache_dir,
-            )
-            print(
-                f"bench_elastic: cache warm-up done in {time.time() - t0:.0f}s",
-                file=sys.stderr,
-            )
+        cache_dir = os.path.join(tmp, "xla-cache") if use_cache else ""
         stable_ips, _, boot_secs, _, _ = run_job(
             tmp, n_records, churn=False, epochs=epochs, cache_dir=cache_dir,
             standby=standby,
@@ -392,6 +376,7 @@ def main():
                 "boot_amortization": BOOT_AMORTIZATION,
                 "workers": N_WORKERS,
                 "standby_workers": standby,
+                "compile_cache": use_cache,
                 "per_seed": per_seed,
                 "target": 0.95,
                 "protocol": (
@@ -414,7 +399,12 @@ def main():
                     "churn throughput, and the churn window is sized >= "
                     f"{BOOT_AMORTIZATION:g}x the measured boot so the "
                     "transients carry the weight they have in a "
-                    "long-running job"
+                    "long-running job. All workers share the job's "
+                    "--compile_cache_dir persistent XLA cache (the "
+                    "framework's default recovery feature; "
+                    "EDL_ELASTIC_BENCH_CACHE=0 disables), so a "
+                    "replacement reuses the incumbents' compiled "
+                    "programs on boot"
                 ),
             }
         )

@@ -12,7 +12,7 @@ master flags by construction (`worker_forward_args`).
 from __future__ import annotations
 
 import argparse
-from typing import List
+from typing import List, Optional
 
 
 def pos_int(value: str) -> int:
@@ -194,6 +194,18 @@ def add_master_args(parser: argparse.ArgumentParser):
     parser.add_argument(
         "--cluster_spec", default="",
         help="python file providing with_pod(pod) for on-prem mutation",
+    )
+    parser.add_argument(
+        "--compile_cache_dir", default="auto",
+        help="persistent XLA compile cache shared by all workers of "
+        "the job, so a relaunched replacement or promoted standby "
+        "reuses the incumbents' compiled programs instead of re-paying "
+        "the XLA compile on boot (the recovery transient the reference "
+        "re-pays on every pod relaunch, k8s_worker_manager.py:139-145)."
+        ' "auto" (default): the master creates a job-scoped directory '
+        "for process workers; on k8s auto is OFF because pods need a "
+        "shared --volume mount to see one cache — pass an explicit "
+        'path on that mount. "" disables',
     )
 
 
@@ -397,6 +409,42 @@ def ps_shard_forward_args(args) -> List[str]:
         if value:
             argv += [f"--{flag}", value]
     return argv
+
+
+def resolve_compile_cache_envs(args, user_envs: Optional[dict] = None) -> dict:
+    """Worker-process env vars realizing --compile_cache_dir.
+
+    The cache MUST arrive as spawn-time environment, not a runtime
+    config call: this image's sitecustomize imports jax before any
+    worker code runs, and JAX_COMPILATION_CACHE_DIR is only honored if
+    it is set when jax initializes (measured: a post-import setenv
+    leaves the cache directory empty). MIN_COMPILE_TIME_SECS=0 caches
+    every program — an elastic job's win is the replacement's boot, and
+    its model may well compile in under the 1s default threshold.
+
+    A user-supplied JAX_COMPILATION_CACHE_DIR in --envs wins over the
+    flag's "auto" default (it is the pre-flag way to share a warm cache
+    across job restarts); auto-created directories are job-scoped and
+    removed at master exit."""
+    if user_envs and "JAX_COMPILATION_CACHE_DIR" in user_envs:
+        return {}
+    cache_dir = getattr(args, "compile_cache_dir", "") or ""
+    if cache_dir == "auto":
+        if getattr(args, "worker_backend", "process") != "process":
+            return {}  # k8s pods need a shared volume: explicit path only
+        import atexit
+        import shutil
+        import tempfile
+
+        cache_dir = tempfile.mkdtemp(prefix="edl-xla-cache-")
+        atexit.register(shutil.rmtree, cache_dir, ignore_errors=True)
+        args.compile_cache_dir = cache_dir  # one dir per job, not per call
+    if not cache_dir:
+        return {}
+    return {
+        "JAX_COMPILATION_CACHE_DIR": cache_dir,
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+    }
 
 
 def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:

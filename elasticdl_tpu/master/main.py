@@ -27,6 +27,7 @@ import time
 from elasticdl_tpu.common.args import (
     master_parser,
     parse_envs,
+    resolve_compile_cache_envs,
     validate_master_args,
     worker_forward_args,
 )
@@ -423,12 +424,19 @@ def main(argv=None) -> int:
         # in-cluster: serve the summaries so the TensorBoard k8s
         # Service (created by the client) has a target on :6006
         servicer.tb_service.start_tensorboard_process()
+    # shared XLA compile cache: incumbents populate it on first boot,
+    # and every relaunched replacement / promoted standby reuses the
+    # compiled programs instead of re-paying the XLA compile
+    user_envs = parse_envs(args.envs)
+    # user --envs win over the flag's auto default (a user-supplied
+    # JAX_COMPILATION_CACHE_DIR IS a compile-cache configuration)
+    worker_envs = {**resolve_compile_cache_envs(args, user_envs), **user_envs}
     manager = WorkerManager(
         backend,
         dispatcher,
         num_workers=args.num_workers,
         worker_argv_fn=lambda wid: worker_forward_args(args, wid, addr),
-        envs=parse_envs(args.envs),
+        envs=worker_envs,
         max_relaunches=args.max_worker_relaunches,
         num_standby=args.num_standby_workers,
     )

@@ -154,7 +154,26 @@ class Worker:
         self._sync_seq = 0  # spawn counter: tags piggyback results
         self._synced_seq = 0  # highest seq whose delta landed on the PS
         self._sync_epoch = 0  # bumped on reset: invalidates spawned syncs
-        self._sync_result = None  # (seq, params_flat, aux) piggyback
+        # Delta-lineage bookkeeping (late-joiner honesty): a window
+        # delta's base_version must name the model state it was
+        # actually computed from — the last merged/pulled state folded
+        # into the local trajectory (`_lineage_version`, and its
+        # per-shard vector) plus our OWN steps spawned since that fold
+        # (own prior deltas are contained in the local trajectory, so
+        # they are part of the base; other workers' progress is not
+        # until an absorb folds it in). Captured at SPAWN time: a delta
+        # computed before an absorb keeps its stale base even if the
+        # push happens after, so the PS's staleness down-weighting sees
+        # the truth. `_own_steps_abs` counts steps spawned over the
+        # worker's lifetime; `_lineage_anchor_abs` marks that counter
+        # at the last fold.
+        self._lineage_version = -1
+        self._shard_lineage = None  # per-shard fold versions
+        self._own_steps_abs = 0
+        self._lineage_anchor_abs = 0
+        self._spawn_abs: Dict[int, int] = {}  # seq -> _own_steps_abs after spawn
+        # (seq, params_flat, aux, version, shard_versions) piggyback
+        self._sync_result = None
         self._base_snapshots: Dict[int, Any] = {}  # seq -> base at spawn
         self._sync_error = None  # exception raised by the async push
         # Per-step pipelining (sync-SGD latency hiding): with
@@ -269,6 +288,9 @@ class Worker:
                 self._shard_versions = versions
                 self._version = min(versions)
                 self._base_version = self._version
+                self._lineage_version = self._version
+                self._shard_lineage = list(versions)
+                self._lineage_anchor_abs = self._own_steps_abs
             self._fresh = True
             return True
         req = {"version": min_version, "method": method}
@@ -298,6 +320,10 @@ class Worker:
                 self._flat = jnp.asarray(codec.ravel_np(resp["params"]))
         self._version = resp["version"]
         if method == MethodType.MINIMUM:
+            with self._report_lock:
+                self._lineage_version = self._version
+                self._shard_lineage = None
+                self._lineage_anchor_abs = self._own_steps_abs
             self._fresh = True
         return True
 
@@ -859,6 +885,21 @@ class Worker:
         of the per-step path (same carry: flat params, opt state, aux)."""
         assert self._use_flat(), "local mode requires flat transport"
         step = self._local_step_core()
+        # XLA:CPU executes convolution *gradients* inside a while-loop
+        # body through a ~40-140x slower fallback path (measured: 48ms
+        # standalone vs 6.7s/step under lax.scan on this image). On CPU
+        # — the process-mode elastic runtime and the test meshes — fully
+        # unroll the window so the body compiles as straight-line code;
+        # on TPU the rolled scan is the right shape (one program,
+        # compile time independent of W). The cap bounds XLA
+        # compile-time/program-size blowup for pathological window
+        # sizes (beyond it a CPU run keeps the loop and eats the slow
+        # path — typical windows are <= 16).
+        unroll = (
+            min(self._local_updates, 32)
+            if jax.default_backend() == "cpu"
+            else 1
+        )
 
         def window(flat, opt_state, aux, features, labels):
             def body(carry, xs):
@@ -868,7 +909,8 @@ class Worker:
                 return (flat, opt_state, aux), loss
 
             (flat, opt_state, aux), losses = jax.lax.scan(
-                body, (flat, opt_state, aux), (features, labels)
+                body, (flat, opt_state, aux), (features, labels),
+                unroll=unroll,
             )
             return flat, opt_state, aux, losses[-1]
 
@@ -1006,6 +1048,25 @@ class Worker:
             # to — the anchor for absorbing this sync's piggybacked
             # merged model while younger deltas are still in flight
             self._base_snapshots[seq] = self._base_flat
+            # the delta's honest base, captured at SPAWN (see the
+            # lineage note in __init__): last folded state + own steps
+            # spawned since. Reading the live counters at push time
+            # instead would let a delta computed before an absorb claim
+            # the absorbed version — staleness 0 for stale content, the
+            # late-joiner bug.
+            own_ahead = self._own_steps_abs - self._lineage_anchor_abs
+            spawn_base_version = (
+                self._lineage_version + own_ahead
+                if self._lineage_version >= 0
+                else self._base_version
+            )
+            spawn_shard_bases = (
+                [v + own_ahead for v in self._shard_lineage]
+                if self._shard_lineage
+                else None
+            )
+            self._own_steps_abs += steps
+            self._spawn_abs[seq] = self._own_steps_abs
 
         def do_sync():
             if prev is not None:
@@ -1030,8 +1091,7 @@ class Worker:
                     [g for _, g in pending_edl],
                 )
             )
-            with self._report_lock:
-                base_version = self._base_version
+            base_version = spawn_base_version
             req = {
                 "delta_flat": delta_h,
                 "steps": steps,
@@ -1072,12 +1132,11 @@ class Worker:
                 # parallel; the master gets only the tiny window
                 # metadata (loss/aux/versions) that drives its
                 # checkpoint/eval cadence and metrics sink
-                with self._report_lock:
-                    base_versions = (
-                        list(self._shard_versions)
-                        if self._shard_versions
-                        else [base_version] * self._ps.num_shards
-                    )
+                base_versions = (
+                    spawn_shard_bases
+                    if spawn_shard_bases is not None
+                    else [base_version] * self._ps.num_shards
+                )
                 versions, merged = self._ps.push_delta(
                     delta_h,
                     steps,
@@ -1111,21 +1170,40 @@ class Worker:
                 if epoch != self._sync_epoch:
                     return  # reset raced the RPC: discard the response
                 self._synced_seq = max(self._synced_seq, seq)
+                merged_back = resp.get("params_flat") is not None
                 if versions is not None:
                     self._shard_versions = versions
                 self._version = resp["version"]
                 self._base_version = resp["version"]
                 self._fresh = True
-                if resp.get("params_flat") is not None:
-                    # device-side rebase must run on the main thread;
-                    # tagged with seq so the absorb can anchor the
-                    # merged model to this delta's base snapshot (a
-                    # newer result supersedes an unabsorbed older one)
+                if merged_back:
+                    # Other workers advanced the PS: the merged model
+                    # must be folded into the local trajectory on the
+                    # main thread (_absorb_sync_result); the LINEAGE
+                    # advances there, not here — deltas spawned in the
+                    # meantime keep their honest stale base (late-joiner
+                    # protocol, see __init__). Tagged with seq so the
+                    # absorb anchors to this delta's base snapshot; a
+                    # newer result supersedes an unabsorbed older one.
                     self._sync_result = (
                         seq,
                         resp["params_flat"],
                         resp.get("aux"),
+                        resp["version"],
+                        versions,
                     )
+                else:
+                    # nobody else advanced: the local trajectory IS the
+                    # PS content — fold point with zero shift
+                    self._lineage_version = resp["version"]
+                    self._shard_lineage = (
+                        list(versions) if versions is not None else None
+                    )
+                    self._lineage_anchor_abs = self._spawn_abs.get(
+                        seq, self._own_steps_abs
+                    )
+                for k in [k for k in self._spawn_abs if k < seq]:
+                    del self._spawn_abs[k]
                 # drop base snapshots this sync has settled — keep only
                 # the one a still-pending piggyback result anchors to
                 pending = (
@@ -1221,6 +1299,12 @@ class Worker:
             self._shard_versions = None
             self._sync_result = None
             self._base_snapshots.clear()
+            # lineage dies with the trajectory; the forced re-pull is
+            # the next fold point
+            self._lineage_version = -1
+            self._shard_lineage = None
+            self._spawn_abs.clear()
+            self._lineage_anchor_abs = self._own_steps_abs
         self._opt_state = None
         self._pending_steps = 0
         self._pending_losses = []
@@ -1247,13 +1331,30 @@ class Worker:
             res = self._sync_result
             if res is None:
                 return
-            seq, params_flat, aux = res
+            seq, params_flat, aux, new_version, new_shard_versions = res
             self._sync_result = None
             snap = self._base_snapshots.get(seq)
             for k in [k for k in self._base_snapshots if k <= seq]:
                 del self._base_snapshots[k]
             if snap is None:
                 return  # reset raced the response: state discarded
+            # the merged progress is folded into the local trajectory
+            # below — deltas spawned from HERE on really are computed
+            # from the new version, so the LINEAGE advances here (see
+            # the late-joiner note in __init__): version = the PS state
+            # this merge reflects, anchor = own steps spawned through
+            # this seq (younger in-flight deltas stay pre-fold)
+            self._lineage_version = new_version
+            self._shard_lineage = (
+                list(new_shard_versions)
+                if new_shard_versions is not None
+                else None
+            )
+            self._lineage_anchor_abs = self._spawn_abs.get(
+                seq, self._own_steps_abs
+            )
+            for k in [k for k in self._spawn_abs if k <= seq]:
+                del self._spawn_abs[k]
             if isinstance(params_flat, dict):
                 # sharded PS: merged slices only for the shards whose
                 # version ran ahead — splice them over the snapshot

@@ -207,6 +207,112 @@ def test_two_workers_sharded_window(tmp_path):
         group.stop()
 
 
+def test_late_joiner_stale_windows_do_not_drag(tmp_path, monkeypatch):
+    """The preemption-recovery regime (the round-4 flake, root-caused):
+    a worker that pulled the model at v0 but lands its windows tens of
+    versions later must not drag the converged model. The protocol
+    guarantee under test is worker-side honesty — `base_version` names
+    the model a delta was actually computed FROM, so versions are
+    adopted only when the merged model is absorbed into the local
+    trajectory, never at response time. Without that, every window
+    spawned before the absorb claimed staleness 0 and the shards'
+    staleness_window down-weighting never fired.
+
+    Determinism: worker B's first pull is forced to the v0 snapshot
+    (the late-joiner premise), push responses are delayed so B's whole
+    stale window chain is in flight before any absorb, and B's sync
+    depth is raised so backpressure doesn't serialize the chain."""
+    import threading
+    import time as _time
+
+    from elasticdl_tpu.rpc.ps_client import ShardedPS
+
+    monkeypatch.setenv("EDL_SYNC_DEPTH", "8")
+    path = str(tmp_path / "late.rio")
+    write_linear_records(path, 128, noise=0.05)
+    spec = spec_from_module(linear_module)
+    group = PSShardGroup(
+        3,
+        mode="inproc",
+        optimizer_factory=linear_module.optimizer,
+        staleness_window=1,
+    )
+    group.start()
+    try:
+        # pin the v0 snapshot the late joiner will claim as its base
+        vec0 = codec.ravel_np(
+            spec.model.init(
+                __import__("jax").random.PRNGKey(123),
+                np.zeros((1, 1), np.float32),
+            )["params"]
+        ).astype(np.float32)
+        group.ensure_init(vec0, version=0)
+
+        # phase 1: worker A alone converges the model (kernel -> 2)
+        dispatcher_a = TaskDispatcher({path: 128}, {}, {}, 16, 4)
+        servicer_a, _e, _c = build_job(spec, dispatcher_a, grads_to_wait=1)
+        servicer_a._ps_group = servicer_a.ps_group = group
+        worker_a = Worker(
+            0,
+            InProcessMaster(servicer_a),
+            spec,
+            minibatch_size=16,
+            local_updates=2,
+            ps_endpoints=group.endpoints,
+        )
+        assert worker_a.run()
+        worker_a.close()
+        versions, vec = group.assemble()
+        v_converged = min(versions)
+        assert v_converged >= 16  # the joiner really is tens behind
+        kernel = codec.unravel_np(vec, servicer_a.get_params_copy()[0])
+        k_a = np.asarray(kernel["Dense_0"]["kernel"]).ravel()[0]
+        assert abs(k_a - 2.0) < 0.5
+
+        # phase 2: worker B re-joins believing the model is at v0
+        dispatcher_b = TaskDispatcher({path: 128}, {}, {}, 16, 2)
+        servicer_b, _e2, _c2 = build_job(spec, dispatcher_b, grads_to_wait=1)
+        servicer_b._ps_group = servicer_b.ps_group = group
+        worker_b = Worker(
+            1,
+            InProcessMaster(servicer_b),
+            spec_from_module(linear_module),
+            minibatch_size=16,
+            local_updates=2,
+            ps_endpoints=group.endpoints,
+        )
+        ps = ShardedPS(group.endpoints, int(vec0.size))
+        stale_pull = {"pending": True}
+        orig_pull, orig_push = ps.pull, ps.push_delta
+
+        def pull(**kwargs):
+            if stale_pull["pending"]:
+                stale_pull["pending"] = False
+                return [0] * 3, vec0.copy()
+            return orig_pull(**kwargs)
+
+        def push_delta(*args, **kwargs):
+            _time.sleep(0.3)  # keep B's whole stale chain in flight
+            return orig_push(*args, **kwargs)
+
+        ps.pull, ps.push_delta = pull, push_delta
+        worker_b._ps = ps
+        assert worker_b.run()
+        worker_b.close()
+        assert dispatcher_b.finished()
+
+        _versions, vec_final = group.assemble()
+        params = codec.unravel_np(vec_final, servicer_b.get_params_copy()[0])
+        k_final = np.asarray(params["Dense_0"]["kernel"]).ravel()[0]
+        # the joiner's stale windows must be staleness-weighted to
+        # noise, not dumped at full weight (pre-fix this lands ~2x off)
+        assert abs(k_final - 2.0) < 0.5, (
+            f"late joiner dragged kernel to {k_final} (A left it at {k_a})"
+        )
+    finally:
+        group.stop()
+
+
 def test_sharded_checkpoint_cadence_via_window_meta(tmp_path):
     """ReportWindowMeta drives the checkpoint service in sharded mode
     the way version bumps do on the single PS."""
@@ -406,8 +512,13 @@ def test_reset_local_state_clears_shard_versions():
     w._fresh = True
     w._version = 7
     w._shard_versions = [7, 7, 7]
-    w._sync_result = (1, None, None)
+    w._sync_result = (1, None, None, 9, None)
     w._base_snapshots = {1: None}
+    w._lineage_version = 7
+    w._shard_lineage = [7, 7, 7]
+    w._own_steps_abs = 4
+    w._lineage_anchor_abs = 2
+    w._spawn_abs = {1: 4}
     w._opt_state = object()
     w._pending_steps = 3
     w._pending_losses = [0.1]

@@ -42,6 +42,11 @@ def _bare_worker():
     w._flushed_report_ids = set()
     w._aux = None
     w._id = 0
+    w._lineage_version = -1
+    w._shard_lineage = None
+    w._own_steps_abs = 0
+    w._lineage_anchor_abs = 0
+    w._spawn_abs = {}
     return w
 
 
@@ -57,13 +62,13 @@ def test_absorb_shifts_younger_snapshots_no_double_merge():
 
     # sync 1's piggyback: other workers contributed shift1
     shift1 = np.array([0.5, -0.5], np.float32)
-    w._sync_result = (1, np.asarray(snap1) + shift1, None)
+    w._sync_result = (1, np.asarray(snap1) + shift1, None, 5, None)
     w._absorb_sync_result()
     np.testing.assert_allclose(np.asarray(w._flat), np.asarray(snap2) + shift1)
 
     # sync 2's piggyback: PS now reflects snap2 + shift1 + others_new
     others_new = np.array([0.25, 0.25], np.float32)
-    w._sync_result = (2, np.asarray(snap2) + shift1 + others_new, None)
+    w._sync_result = (2, np.asarray(snap2) + shift1 + others_new, None, 7, None)
     w._absorb_sync_result()
     # shift1 must be applied ONCE, others_new once
     np.testing.assert_allclose(
