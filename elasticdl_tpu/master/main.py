@@ -447,10 +447,12 @@ def main(argv=None) -> int:
                 make_sample_batch_fn(args.training_data_dir)
             )
     ps_dead = threading.Event()
-    if servicer.ps_group is not None:
-        # PS shards are job-lifetime with no relaunch path: a dead
-        # shard means every future push/pull fails, so fail the whole
-        # job fast instead of letting the workers crash-loop
+    if servicer.ps_group is not None or servicer.kv_group is not None:
+        # PS and KV shards are job-lifetime with no relaunch path: a
+        # dead shard means every future push/pull/lookup fails, so fail
+        # the whole job fast instead of letting the workers crash-loop
+        # (the worker_manager routes terminal events for BOTH replica
+        # types through this hook)
         manager.on_ps_failure = lambda sid: ps_dead.set()
     manager.start_workers()
     logger.info("Worker manager status: %s", WorkerManagerStatus.RUNNING)
@@ -461,7 +463,7 @@ def main(argv=None) -> int:
         # faster here — process workers finish in seconds under test
         while not dispatcher.finished():
             if ps_dead.is_set():
-                logger.error("a PS shard died: aborting the job")
+                logger.error("a PS/KV shard died: aborting the job")
                 exit_code = 2
                 break
             if manager.all_exited():

@@ -578,9 +578,19 @@ class MasterServicer:
                 prev, version
             ):
                 # assembled AFTER the crossing report: a relaxed
-                # snapshot at >= the crossing version (ps_shard.py)
+                # snapshot at >= the crossing version (ps_shard.py).
+                # Shard optimizer state rides along (same shape as
+                # save_latest_checkpoint) — without it a resume from a
+                # CADENCE checkpoint of a sharded job silently
+                # cold-starts the optimizer moments (ADVICE r4)
                 params, aux, v = self.get_params_copy()
-                ckpt_snapshot = (params, aux, None)
+                shard_states = self._ps_group.export_opt()
+                opt_state = (
+                    {"kind": "sharded", "shards": shard_states}
+                    if shard_states is not None
+                    else None
+                )
+                ckpt_snapshot = (params, aux, opt_state)
                 version = max(version, v)
             self._on_version_bump(version, ckpt_snapshot, prev)
         # every applied report carries a real loss even when its min

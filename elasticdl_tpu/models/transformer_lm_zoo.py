@@ -47,11 +47,15 @@ class TransformerLM:
     def apply(self, variables, tokens):
         # the vectorized scan-over-layers fast path for dense AND MoE
         # (capacity-bounded einsum dispatch, parallel/moe.moe_ffn_local).
-        # The Switch aux loss is dropped here: the zoo spec contract is
-        # loss(outputs, labels), so only the LM loss reaches the PS —
-        # router balance regularization lives in the mesh path
-        # (build_loss_fn), which serious MoE training drives.
-        logits, _aux = plain_forward(self.cfg, variables["params"], tokens)
+        # MoE configs return (logits, aux): the Switch load-balance
+        # term must reach loss() or top-1 routed experts train with no
+        # balance regularizer on the PS runtime and collapse on longer
+        # runs (ADVICE r4) — `loss`/`eval_metrics_fn` below unpack the
+        # pair, mirroring the mesh path's build_loss_fn
+        # (transformer_lm.py:243-253).
+        logits, aux = plain_forward(self.cfg, variables["params"], tokens)
+        if self.cfg.n_experts:
+            return logits, self.cfg.aux_weight * aux
         return logits
 
 
@@ -68,8 +72,16 @@ def dataset_fn(records, mode):
     return tokens[:, :-1], tokens[:, 1:].astype(np.int32)
 
 
+def _split_outputs(outputs):
+    """(logits, weighted_aux) for MoE configs, (logits, 0) for dense."""
+    if isinstance(outputs, tuple):
+        return outputs
+    return outputs, jnp.zeros((), dtype=jnp.float32)
+
+
 def loss(outputs, labels):
-    return token_cross_entropy(outputs, labels)
+    logits, aux = _split_outputs(outputs)
+    return token_cross_entropy(logits, labels) + aux.astype(jnp.float32)
 
 
 def optimizer():
@@ -80,6 +92,7 @@ def optimizer():
 
 
 def eval_metrics_fn(predictions, labels):
-    ce = token_cross_entropy(predictions, labels)
-    acc = jnp.mean(jnp.argmax(predictions, axis=-1) == labels)
+    logits, _aux = _split_outputs(predictions)
+    ce = token_cross_entropy(logits, labels)
+    acc = jnp.mean(jnp.argmax(logits, axis=-1) == labels)
     return {"cross_entropy": ce, "accuracy": acc, "perplexity": jnp.exp(ce)}

@@ -164,6 +164,9 @@ class ShardedEmbeddingStore:
         )
 
     def close(self):
-        self._pool.shutdown(wait=False)
+        # drain in-flight lookups/updates first (shard RPCs are short):
+        # closing the channels under a still-submitting window sync
+        # turns clean teardown into closed-channel errors (ADVICE r4)
+        self._pool.shutdown(wait=True)
         for c in self._clients:
             c.close()
