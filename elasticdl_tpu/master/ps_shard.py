@@ -46,6 +46,7 @@ sees every report exactly once).
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -96,6 +97,14 @@ class PSShardServicer:
         self._version = 0
         self._grad_sum: Optional[np.ndarray] = None
         self._grad_n = 0
+        # Push dedup ring (report_key -> None, insertion-ordered): a
+        # retried push whose first attempt WAS applied (gRPC can surface
+        # UNAVAILABLE after the server processed the request) must
+        # no-op instead of double-applying — this is what makes the
+        # client's transient retry safe for mutating ops and shrinks
+        # the torn-report window to hard shard death (ADVICE r3 #2).
+        self._seen_reports: "OrderedDict[str, None]" = OrderedDict()
+        self._seen_cap = 512
 
     # -- handler table -------------------------------------------------------
 
@@ -175,6 +184,12 @@ class PSShardServicer:
         with self._lock:
             if self._vec is None:
                 raise ValueError("gradient pushed before shard init")
+            if self._is_duplicate(req):
+                resp = {"accepted": True, "version": self._version,
+                        "duplicate": True}
+                if req.get("return_model"):
+                    resp["vec"] = self._wire_vec(req)
+                return resp
             if grad.shape != self._vec.shape:
                 raise ValueError(
                     f"grad slice shape {grad.shape} != {self._vec.shape}"
@@ -216,6 +231,14 @@ class PSShardServicer:
         with self._lock:
             if self._vec is None:
                 raise ValueError("delta pushed before shard init")
+            if self._is_duplicate(req):
+                # already applied: answer like a base-fell-behind merge
+                # so a retrying worker still rebases onto the result
+                return {
+                    "version": self._version,
+                    "vec": self._wire_vec(req),
+                    "duplicate": True,
+                }
             delta = np.asarray(req["delta"], dtype=np.float32)
             if delta.shape != self._vec.shape:
                 raise ValueError(
@@ -234,6 +257,19 @@ class PSShardServicer:
             return resp
 
     # -- internals -----------------------------------------------------------
+
+    def _is_duplicate(self, req: dict) -> bool:
+        """Record req's report_key; True if it was already applied
+        (caller holds the lock). Keyless pushes are never deduped."""
+        key = req.get("report_key")
+        if not key:
+            return False
+        if key in self._seen_reports:
+            return True
+        self._seen_reports[key] = None
+        while len(self._seen_reports) > self._seen_cap:
+            self._seen_reports.popitem(last=False)
+        return False
 
     def _wire_vec(self, req: dict) -> np.ndarray:
         dtype = req.get("model_dtype")

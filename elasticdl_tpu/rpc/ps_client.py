@@ -10,6 +10,7 @@ whole point of sharding the PS; SURVEY §7.3 item 3). Slices follow
 from __future__ import annotations
 
 import time
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -45,21 +46,21 @@ class ShardedPS:
         """fn(client, shard_index) on every shard concurrently; returns
         results in shard order, re-raising the first failure.
 
-        Failure model — TORN REPORTS. Shards apply their slices
-        independently; there is no cross-shard transaction, so when one
-        shard's RPC fails after the others applied theirs, the report is
-        torn: some slices saw it, the failed slice never will. The
-        caller (worker) responds by resetting local state and
-        re-training the covered tasks, so no *work* is lost, but the
-        applied slices' version histories run ahead by one report —
-        permanent exactness across slices would need 2PC, which this
-        plane deliberately omits (ps_shard.py design note). Retries
-        narrow the transient-blip window but only for IDEMPOTENT ops
-        (pull, wait_ready, SETNX init): gRPC can surface UNAVAILABLE
-        *after* the server processed a request (connection reset before
-        the response lands), so resending a push_grad/push_delta could
-        silently double-apply a slice — strictly worse than the torn
-        report, which at least surfaces to the caller's reset path."""
+        Failure model — TORN REPORTS, now bounded to hard shard death.
+        Shards apply their slices independently; there is no
+        cross-shard transaction, so when one shard's RPC fails for good
+        after the others applied theirs, the report is torn: the caller
+        (worker) resets local state and re-trains the covered tasks, so
+        no *work* is lost, but the applied slices' version histories
+        run ahead by one report — permanent exactness across slices
+        would need 2PC, which this plane deliberately omits
+        (ps_shard.py design note). TRANSIENT blips no longer tear:
+        every op retries UNAVAILABLE up to 2 more times. Reads/init are
+        naturally idempotent; pushes carry a per-report `report_key`
+        the shard dedups on (ps_shard.py `_is_duplicate`), so a resend
+        whose first attempt WAS applied (gRPC can surface UNAVAILABLE
+        after the server processed the request) no-ops instead of
+        double-applying."""
 
         def with_retry(c, i):
             for attempt in range(3):
@@ -161,6 +162,8 @@ class ShardedPS:
         if delta.size != self.n_params:
             raise ValueError(f"delta size {delta.size} != {self.n_params}")
 
+        report_key = uuid.uuid4().hex  # shard-side dedup: retry-safe
+
         def do(c, i):
             s, e = self.bounds[i]
             req = {
@@ -168,12 +171,13 @@ class ShardedPS:
                 "steps": steps,
                 "base_version": base_versions[i],
                 "want_model": want_model,
+                "report_key": report_key,
             }
             if model_dtype:
                 req["model_dtype"] = model_dtype
             return c.call("PSPushDelta", req)
 
-        resps = self._map(do)
+        resps = self._map(do, idempotent=True)
         merged = {
             i: r["vec"] for i, r in enumerate(resps) if r.get("vec") is not None
         }
@@ -194,18 +198,21 @@ class ShardedPS:
         if grad.size != self.n_params:
             raise ValueError(f"grad size {grad.size} != {self.n_params}")
 
+        report_key = uuid.uuid4().hex  # shard-side dedup: retry-safe
+
         def do(c, i):
             s, e = self.bounds[i]
             req = {
                 "grad": grad[s:e],
                 "version": versions[i],
                 "return_model": return_model,
+                "report_key": report_key,
             }
             if model_dtype:
                 req["model_dtype"] = model_dtype
             return c.call("PSPushGrad", req)
 
-        resps = self._map(do)
+        resps = self._map(do, idempotent=True)
         new_versions = [r["version"] for r in resps]
         vec = None
         if return_model and all(r.get("vec") is not None for r in resps):
