@@ -1,0 +1,89 @@
+"""Mergeable evaluation-metric states.
+
+The reference accumulates per-metric weighted sums over minibatches and
+averages at completion (reference: evaluation_service.py:28-52). That
+is exact for decomposable means (accuracy, mse) but WRONG for
+non-decomposable metrics: an average of per-batch AUCs is not the job
+AUC (the reference's deepfm zoo has exactly this flaw,
+model_zoo/deepfm_edl_embedding/deepfm_edl_embedding.py:56-60).
+
+Here a metric may instead return mergeable STATE — a dict tagged with
+a `kind` — which workers report per minibatch, the evaluation service
+reduces by summation, and `finalize_metric_state` turns into the exact
+job-level scalar at completion. The state shapes are fixed-size
+(independent of batch count), jit-friendly (pure jnp, static shapes),
+and sum-mergeable, so they ride the existing metric wire unchanged.
+
+Kinds:
+- ``auc_bins``: positive/negative counts bucketed over score-threshold
+  bins (the tf.keras.metrics.AUC discretization the reference's deepfm
+  used, num_thresholds bins); finalization is the rank/trapezoid form
+  with in-bin ties counted half — exact up to bin collisions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_NUM_THRESHOLDS = 512
+
+
+def is_mergeable_state(value: Any) -> bool:
+    return isinstance(value, dict) and "kind" in value
+
+
+def auc_state(scores, labels, num_thresholds: int = DEFAULT_NUM_THRESHOLDS):
+    """Per-batch mergeable AUC state (jit-safe, fixed [T] shape).
+
+    `scores` are logits (any real range — bucketed through sigmoid);
+    `labels` binary. Merge = elementwise sum; finalize with
+    `finalize_metric_state`."""
+    scores = jnp.ravel(scores)
+    labels = jnp.ravel(labels)
+    p = jax.nn.sigmoid(scores.astype(jnp.float32))
+    idx = jnp.clip(
+        (p * num_thresholds).astype(jnp.int32), 0, num_thresholds - 1
+    )
+    pos = (labels > 0.5).astype(jnp.float32)
+    pos_hist = jnp.zeros(num_thresholds, jnp.float32).at[idx].add(pos)
+    neg_hist = jnp.zeros(num_thresholds, jnp.float32).at[idx].add(1.0 - pos)
+    return {"kind": "auc_bins", "pos": pos_hist, "neg": neg_hist}
+
+
+def merge_metric_states(acc: Dict, state: Dict) -> Dict:
+    """Elementwise-sum merge of two same-kind states (host side)."""
+    if acc.get("kind") != state.get("kind"):
+        raise ValueError(
+            f"cannot merge metric kinds {acc.get('kind')!r} and "
+            f"{state.get('kind')!r}"
+        )
+    out = {"kind": acc["kind"]}
+    for k, v in acc.items():
+        if k == "kind":
+            continue
+        out[k] = np.asarray(v, dtype=np.float64) + np.asarray(
+            state[k], dtype=np.float64
+        )
+    return out
+
+
+def finalize_metric_state(state: Dict) -> float:
+    """Exact job-level scalar from an accumulated state."""
+    kind = state.get("kind")
+    if kind == "auc_bins":
+        pos = np.asarray(state["pos"], dtype=np.float64)
+        neg = np.asarray(state["neg"], dtype=np.float64)
+        n_pos, n_neg = pos.sum(), neg.sum()
+        if n_pos == 0 or n_neg == 0:
+            return 0.5
+        # P(score_pos > score_neg) + 0.5 P(tie), ties = same bin:
+        # for each bin, its positives rank above all negatives in
+        # strictly lower bins and tie with its own bin's negatives
+        cum_neg_below = np.concatenate(([0.0], np.cumsum(neg)[:-1]))
+        u = np.sum(pos * (cum_neg_below + 0.5 * neg))
+        return float(u / (n_pos * n_neg))
+    raise ValueError(f"unknown mergeable metric kind: {kind!r}")

@@ -32,6 +32,7 @@ class _EvaluationJob:
         self._total_tasks = total_tasks
         self._completed_tasks = 0
         self._metric_sums: Dict[str, float] = {}
+        self._metric_states: Dict[str, Dict] = {}  # mergeable states
         self._num_examples = 0
 
     def complete_task(self):
@@ -41,16 +42,39 @@ class _EvaluationJob:
         return self._completed_tasks >= self._total_tasks
 
     def report_metrics(self, metrics: Dict[str, float], num_examples: int):
+        """Scalars accumulate as example-weighted sums (exact for
+        decomposable means, the reference semantics); mergeable STATES
+        (api/metrics.py — e.g. threshold-bin counts for AUC) reduce by
+        summation and finalize exactly at completion, fixing the
+        average-of-per-batch-AUCs flaw the reference inherits from its
+        deepfm zoo."""
+        from elasticdl_tpu.api.metrics import (
+            is_mergeable_state,
+            merge_metric_states,
+        )
+
         for name, value in metrics.items():
-            self._metric_sums[name] = (
-                self._metric_sums.get(name, 0.0) + float(value) * num_examples
-            )
+            if is_mergeable_state(value):
+                acc = self._metric_states.get(name)
+                self._metric_states[name] = (
+                    merge_metric_states(acc, value) if acc else dict(value)
+                )
+            else:
+                self._metric_sums[name] = (
+                    self._metric_sums.get(name, 0.0)
+                    + float(value) * num_examples
+                )
         self._num_examples += num_examples
 
     def get_metrics(self) -> Dict[str, float]:
+        from elasticdl_tpu.api.metrics import finalize_metric_state
+
         if not self._num_examples:
             return {}
-        return {k: v / self._num_examples for k, v in self._metric_sums.items()}
+        out = {k: v / self._num_examples for k, v in self._metric_sums.items()}
+        for name, state in self._metric_states.items():
+            out[name] = finalize_metric_state(state)
+        return out
 
 
 class _EvaluationTrigger(threading.Thread):

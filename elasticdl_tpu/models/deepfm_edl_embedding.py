@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import optax
 
 from elasticdl_tpu.api.layers import EmbeddingSpec, embedding_forward
-from elasticdl_tpu.models.deepfm_functional_api import _auc
+
 from elasticdl_tpu.models.record_codec import decode_tabular_records
 
 NUM_FIELDS = 10
@@ -66,9 +66,13 @@ def optimizer():
 
 
 def eval_metrics_fn(predictions, labels):
+    from elasticdl_tpu.api.metrics import auc_state
+
     return {
         "accuracy": jnp.mean(
             ((predictions > 0) == (labels > 0.5)).astype(jnp.float32)
         ),
-        "auc": _auc(predictions, labels),
+        # job-exact AUC via mergeable threshold-bin state (see
+        # deepfm_functional_api.eval_metrics_fn)
+        "auc": auc_state(predictions, labels),
     }

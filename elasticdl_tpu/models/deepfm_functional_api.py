@@ -67,9 +67,16 @@ def _auc(scores, labels):
 
 
 def eval_metrics_fn(predictions, labels):
+    from elasticdl_tpu.api.metrics import auc_state
+
     return {
         "accuracy": jnp.mean(
             ((predictions > 0) == (labels > 0.5)).astype(jnp.float32)
         ),
-        "auc": _auc(predictions, labels),
+        # mergeable state: the eval service sums threshold-bin counts
+        # across minibatches and finalizes the JOB-level AUC exactly —
+        # an average of per-batch AUCs is not the job AUC (the flaw in
+        # reference deepfm_edl_embedding.py:56-60). `_auc` stays for
+        # single-batch use (benches, notebooks).
+        "auc": auc_state(predictions, labels),
     }

@@ -1715,11 +1715,22 @@ class Worker:
                     else self._ragged_eval_step()
                 )
                 outputs = step(self._step_params(), self._aux, embs, features, labels)
+                raw = self._spec.eval_metrics_fn(outputs, jnp.asarray(labels))
+                # scalars go over the wire as floats; mergeable states
+                # (api/metrics.py) as host arrays — the eval service
+                # sums states and finalizes exactly at job completion
                 metrics = {
-                    k: float(v)
-                    for k, v in self._spec.eval_metrics_fn(
-                        outputs, jnp.asarray(labels)
-                    ).items()
+                    k: (
+                        {
+                            sk: sv
+                            if isinstance(sv, str)
+                            else np.asarray(jax.device_get(sv))
+                            for sk, sv in v.items()
+                        }
+                        if isinstance(v, dict)
+                        else float(v)
+                    )
+                    for k, v in raw.items()
                 }
                 n = len(jax.tree_util.tree_leaves(features)[0])
                 self._master.call(

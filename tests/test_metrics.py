@@ -1,0 +1,100 @@
+"""Mergeable evaluation metrics (api/metrics.py + evaluation_service).
+
+The contract: per-batch STATES summed across minibatches and finalized
+at job completion equal the metric computed over the POOLED
+predictions — which per-batch scalar averaging cannot deliver for
+non-decomposable metrics like AUC (reference flaw:
+evaluation_service.py:28-52 averaging + deepfm_edl_embedding.py:56-60
+per-batch AUC).
+"""
+
+import numpy as np
+
+from elasticdl_tpu.api.metrics import (
+    auc_state,
+    finalize_metric_state,
+    merge_metric_states,
+)
+from elasticdl_tpu.master.evaluation_service import _EvaluationJob
+
+
+def _exact_auc(scores, labels):
+    """Rank-based (Mann-Whitney) reference, ties averaged — what
+    sklearn.roc_auc_score computes."""
+    scores = np.asarray(scores, dtype=np.float64)
+    labels = np.asarray(labels) > 0.5
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty_like(scores)
+    # average ranks over ties
+    sorted_scores = scores[order]
+    r = np.arange(1, len(scores) + 1, dtype=np.float64)
+    i = 0
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        r[i : j + 1] = (i + 1 + j + 1) / 2.0
+        i = j + 1
+    ranks[order] = r
+    n_pos = labels.sum()
+    n_neg = len(labels) - n_pos
+    return (ranks[labels].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_merged_auc_state_matches_pooled_exact_auc():
+    rng = np.random.default_rng(0)
+    scores = rng.normal(scale=2.0, size=512)
+    # correlated labels so AUC is far from 0.5
+    labels = (scores + rng.normal(scale=1.5, size=512) > 0).astype(np.float32)
+
+    acc = None
+    for i in range(0, 512, 64):  # 8 minibatches
+        st = {
+            k: np.asarray(v)
+            for k, v in auc_state(scores[i : i + 64], labels[i : i + 64]).items()
+            if True
+        }
+        acc = st if acc is None else merge_metric_states(acc, st)
+    merged = finalize_metric_state(acc)
+    exact = _exact_auc(scores, labels)
+    assert abs(merged - exact) < 0.01, (merged, exact)
+
+    # the per-batch-average number the old path produced is NOT the
+    # job AUC — guard that the fix actually changes the semantics
+    per_batch = np.mean(
+        [
+            _exact_auc(scores[i : i + 64], labels[i : i + 64])
+            for i in range(0, 512, 64)
+        ]
+    )
+    assert abs(merged - exact) < abs(per_batch - exact) or abs(
+        per_batch - exact
+    ) < 1e-4
+
+
+def test_evaluation_job_mixes_scalars_and_states():
+    rng = np.random.default_rng(1)
+    scores = rng.normal(size=256)
+    labels = (scores + rng.normal(scale=1.0, size=256) > 0).astype(np.float32)
+    job = _EvaluationJob(model_version=3, total_tasks=4)
+    for i in range(0, 256, 64):
+        s, l = scores[i : i + 64], labels[i : i + 64]
+        job.report_metrics(
+            {
+                "accuracy": float(((s > 0) == (l > 0.5)).mean()),
+                "auc": {
+                    k: np.asarray(v) for k, v in auc_state(s, l).items()
+                },
+            },
+            num_examples=64,
+        )
+        job.complete_task()
+    assert job.finished()
+    metrics = job.get_metrics()
+    assert abs(metrics["accuracy"] - ((scores > 0) == (labels > 0.5)).mean()) < 1e-9
+    assert abs(metrics["auc"] - _exact_auc(scores, labels)) < 0.01
+
+
+def test_auc_state_degenerate_single_class():
+    st = {k: np.asarray(v) for k, v in auc_state(np.ones(8), np.ones(8)).items()}
+    assert finalize_metric_state(st) == 0.5
