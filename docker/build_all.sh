@@ -1,0 +1,10 @@
+#!/bin/sh
+# Build the committed image stack from the repo root:
+#   sh docker/build_all.sh [extra docker build args...]
+# Produces elasticdl-tpu:base, :dev (pre-generated /data), :ci.
+set -e
+cd "$(dirname "$0")/.."
+docker build -f docker/Dockerfile     -t elasticdl-tpu:base "$@" .
+docker build -f docker/Dockerfile.dev -t elasticdl-tpu:dev  "$@" .
+docker build -f docker/Dockerfile.ci  -t elasticdl-tpu:ci   "$@" .
+echo "built elasticdl-tpu:base, elasticdl-tpu:dev, elasticdl-tpu:ci"
