@@ -320,22 +320,32 @@ def main():
     write_synthetic_tabular_records(
         dfm_path, dfm_n, deepfm_edl_embedding.NUM_FIELDS, 10000
     )
-    dfm_recs_per_sec, dfm_worker, dfm_elapsed = run_job(
-        deepfm_edl_embedding,
-        dfm_path,
-        dfm_n,
-        minibatch=minibatch,
-        records_per_task=dfm_window * minibatch,
-        epochs=1,
-        local_updates=dfm_window,
-        grads_to_wait=1,
-    )
-    print(
-        f"bench[deepfm sparse window]: {dfm_n} recs in {dfm_elapsed:.1f}s "
-        f"= {dfm_recs_per_sec:.1f} rec/s; "
-        f"phases {dfm_worker.timers.summary()}",
-        file=sys.stderr,
-    )
+    # same-run A/B: prefetch OFF first, then ON (the order biases
+    # against the feature — ON pays any store-warming the OFF run left)
+    dfm_pair = {}
+    for pf in ("0", "1"):
+        os.environ["EDL_BET_PREFETCH"] = pf
+        recs_per_sec, dfm_worker, dfm_elapsed = run_job(
+            deepfm_edl_embedding,
+            dfm_path,
+            dfm_n,
+            minibatch=minibatch,
+            records_per_task=dfm_window * minibatch,
+            epochs=1,
+            local_updates=dfm_window,
+            grads_to_wait=1,
+        )
+        dfm_pair["prefetch_on" if pf == "1" else "prefetch_off"] = round(
+            recs_per_sec, 1
+        )
+        print(
+            f"bench[deepfm sparse window prefetch={pf}]: {dfm_n} recs in "
+            f"{dfm_elapsed:.1f}s = {recs_per_sec:.1f} rec/s; "
+            f"phases {dfm_worker.timers.summary()}",
+            file=sys.stderr,
+        )
+    os.environ.pop("EDL_BET_PREFETCH", None)
+    dfm_recs_per_sec = dfm_pair["prefetch_on"]
 
     # ---- north-star model: ResNet-50 chip throughput ----
     # (bench_resnet.py holds the full story incl. the elastic-runtime
@@ -345,11 +355,16 @@ def main():
     if on_tpu:
         from bench_resnet import chip_throughput
 
+        # b256: +40% img/s over the b64 number earlier rounds carried
+        # (batch is the biggest MFU lever; sweep + trace breakdown in
+        # docs/resnet_mfu.md) and weather-stable (longer scans amortize
+        # launch latency)
         r_ips, r_tf, r_mfu, _rl = chip_throughput(
-            res=224, batch=64, steps=16, reps=3
+            res=224, batch=256, steps=8, reps=3
         )
         resnet = {
             "images_per_sec_chip_224": round(r_ips, 1),
+            "batch": 256,
             "tflops_per_sec": round(r_tf, 2),
             "mfu_vs_v5e_bf16_peak": round(r_mfu, 4),
         }
@@ -368,9 +383,8 @@ def main():
                 "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
                 "per_step_images_per_sec": round(ps_imgs_per_sec, 1),
                 "per_step_serial_images_per_sec": round(ps_serial_imgs, 1),
-                "deepfm_sparse_window_records_per_sec": round(
-                    dfm_recs_per_sec, 1
-                ),
+                "deepfm_sparse_window_records_per_sec": dfm_recs_per_sec,
+                "deepfm_bet_prefetch_ab": dfm_pair,
                 "resnet50_chip": resnet,
                 "window_runs_images_per_sec": [
                     round(a[0], 1) for a in attempts
@@ -427,7 +441,12 @@ def main():
                     "latency per round instead. The deepfm number is "
                     "the elastic-embedding sparse plane through window "
                     "mode (per-batch BET lookups, accumulated "
-                    "IndexedRows riding each delta sync); resnet50_chip "
+                    "IndexedRows riding each delta sync), reported as a "
+                    "same-run A/B pair: prefetch_off fetches each "
+                    "batch's rows inline, prefetch_on overlaps batch "
+                    "N+1's lookups + lazy-init draws with batch N's "
+                    "compute on a background thread (off runs first, "
+                    "biasing against the feature); resnet50_chip "
                     "is the north-star model's device-resident full "
                     "train step (see bench_resnet.py for the "
                     "elastic-runtime variant and the input-bandwidth "

@@ -11,11 +11,13 @@ matmuls in fp32 accumulation.
 
 No reference equivalent (the 2019 reference has no attention model);
 this is the "pallas kernels for the hot ops" arm of the TPU-first
-design. The kernel is forward-only; the backward pass recomputes
-attention with the plain jnp math under `jax.vjp` (flash-style
-recompute: nothing but q, k, v is saved — same memory story as
-jax.checkpoint, and XLA fuses the recompute well). Numerics are
-validated block-for-block against the reference math in
+design. Both directions are Pallas kernels: the forward also emits the
+per-row logsumexp, and the backward is the standard two-kernel flash
+scheme — a dq kernel gridded over q-blocks and a dk/dv kernel gridded
+over k-blocks, each re-forming p = exp(s - lse) from the residuals so
+nothing quadratic is ever saved (FlashAttention-2 recompute layout; no
+atomics — each kernel owns its output block). Numerics are validated
+block-for-block against the reference math in
 tests/test_flash_attention.py, in Pallas interpret mode on CPU and
 compiled under EDL_TPU_TESTS=1 on the chip.
 
@@ -49,14 +51,23 @@ def reference_attention(q, k, v, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, n_blocks: int, causal: bool,
-               scale: float):
+def _causal_mask(qi, kj, s):
+    """Mask s [BQ, BK] by global position for the (qi, kj) block pair;
+    off-diagonal visible blocks pass through unchanged."""
+    rows = qi * BLOCK + jax.lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 0)
+    cols = kj * BLOCK + jax.lax.broadcasted_iota(jnp.int32, (BLOCK, BLOCK), 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, n_blocks: int,
+               causal: bool, scale: float):
     """One q-block program: q_ref/o_ref are [1, BLOCK, D]; k_ref/v_ref
     hold the full [1, L, D] sequence (constant across the q-block grid
     dimension, so Mosaic keeps them resident in VMEM). fori_loop over
     k-blocks with the flash m/l/acc online softmax; causal runs the
     loop only up to the diagonal block and masks inside it by global
-    position."""
+    position. Also emits the per-row logsumexp (m + log l) — the
+    backward kernels re-form p = exp(s - lse) from it."""
     qi = pl.program_id(1)
     q = q_ref[0]  # [BLOCK, D], input dtype: MXU-native operands
     d = q.shape[-1]
@@ -74,15 +85,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, n_blocks: int, causal: bool,
             preferred_element_type=jnp.float32,
         ) * scale  # [BQ, BK]
         if causal:
-            # global-position mask; off-diagonal blocks (kj < qi) are
-            # all-visible and the mask is all-True there
-            rows = qi * BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK, BLOCK), 0
-            )
-            cols = kj * BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK, BLOCK), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = _causal_mask(qi, kj, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
@@ -100,50 +103,186 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, n_blocks: int, causal: bool,
         jnp.zeros((BLOCK, 1), jnp.float32),
     )
     hi = qi + 1 if causal else n_blocks
-    acc, _m, l = jax.lax.fori_loop(0, hi, body, init)
+    acc, m, l = jax.lax.fori_loop(0, hi, body, init)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _fold(x, b, L, h, d):
+    return x.transpose(0, 2, 1, 3).reshape(b * h, L, d)
+
+
+def _unfold(x, b, L, h, d):
+    return x.reshape(b, h, L, d).transpose(0, 2, 1, 3)
 
 
 def _flash_forward(q, k, v, causal: bool, interpret: bool):
+    """Returns (o [B,L,H,D], lse [B*H, L])."""
     b, L, h, d = q.shape
     assert L % BLOCK == 0, f"L={L} must divide by {BLOCK}"
     n_blocks = L // BLOCK
     scale = 1.0 / math.sqrt(d)
     # [B, L, H, D] -> [B*H, L, D]; grid = (head, q-block)
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, L, d)  # noqa: E731
-    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf, kf, vf = (_fold(x, b, L, h, d) for x in (q, k, v))
     qo_spec = pl.BlockSpec((1, BLOCK, d), lambda i, j: (i, j, 0))
     kv_spec = pl.BlockSpec((1, L, d), lambda i, j: (i, 0, 0))
-    out = pl.pallas_call(
+    lse_spec = pl.BlockSpec((1, BLOCK), lambda i, j: (i, j))
+    out, lse = pl.pallas_call(
         functools.partial(
             _fa_kernel, n_blocks=n_blocks, causal=causal, scale=scale
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, L, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, L, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, L), jnp.float32),
+        ],
         grid=(b * h, n_blocks),
         in_specs=[qo_spec, kv_spec, kv_spec],
-        out_specs=qo_spec,
+        out_specs=[qo_spec, lse_spec],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, L, d).transpose(0, 2, 1, 3)
+    return _unfold(out, b, L, h, d), lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               n_blocks: int, causal: bool, scale: float):
+    """dq for one q-block: loop over visible k-blocks, re-form
+    p = exp(s - lse), ds = p * (do v^T - delta) * scale, dq += ds k."""
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [BQ, D]
+    do = do_ref[0]
+    lse = lse_ref[0][:, None]  # [BQ, 1]
+    delta = delta_ref[0][:, None]
+    d = q.shape[-1]
+
+    def body(kj, acc):
+        kb = k_ref[0, pl.ds(kj * BLOCK, BLOCK), :]
+        vb = v_ref[0, pl.ds(kj * BLOCK, BLOCK), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(qi, kj, s)
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta) * scale).astype(kb.dtype)
+        return acc + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    hi = qi + 1 if causal else n_blocks
+    acc = jax.lax.fori_loop(0, hi, body, jnp.zeros((BLOCK, d), jnp.float32))
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, n_blocks: int, causal: bool, scale: float):
+    """dk/dv for one k-block: loop over the q-blocks that can see it
+    (qi >= kj causal); each kernel owns its output block — no
+    atomics."""
+    kj = pl.program_id(1)
+    kb = k_ref[0]  # [BK, D]
+    vb = v_ref[0]
+    d = kb.shape[-1]
+
+    def body(qi, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(qi * BLOCK, BLOCK), :]
+        do = do_ref[0, pl.ds(qi * BLOCK, BLOCK), :]
+        lse = lse_ref[0, pl.ds(qi * BLOCK, BLOCK)][:, None]
+        delta = delta_ref[0, pl.ds(qi * BLOCK, BLOCK)][:, None]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(qi, kj, s)
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta) * scale).astype(qb.dtype)
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    lo = kj if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lo,
+        n_blocks,
+        body,
+        (
+            jnp.zeros((BLOCK, d), jnp.float32),
+            jnp.zeros((BLOCK, d), jnp.float32),
+        ),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
+    b, L, h, d = q.shape
+    n_blocks = L // BLOCK
+    scale = 1.0 / math.sqrt(d)
+    qf, kf, vf, of, gf = (_fold(x, b, L, h, d) for x in (q, k, v, o, g))
+    # delta_i = rowsum(do_i * o_i): tiny elementwise+reduce, XLA fuses
+    delta = jnp.sum(
+        gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
+    )  # [B*H, L]
+    blk = pl.BlockSpec((1, BLOCK, d), lambda i, j: (i, j, 0))
+    seq = pl.BlockSpec((1, L, d), lambda i, j: (i, 0, 0))
+    row_blk = pl.BlockSpec((1, BLOCK), lambda i, j: (i, j))
+    row_seq = pl.BlockSpec((1, L), lambda i, j: (i, 0))
+    kw = dict(n_blocks=n_blocks, causal=causal, scale=scale)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **kw),
+        out_shape=jax.ShapeDtypeStruct((b * h, L, d), q.dtype),
+        grid=(b * h, n_blocks),
+        in_specs=[blk, seq, seq, blk, row_blk, row_blk],
+        out_specs=blk,
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **kw),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, L, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, L, d), v.dtype),
+        ],
+        grid=(b * h, n_blocks),
+        in_specs=[seq, blk, blk, seq, row_seq, row_seq],
+        out_specs=[blk, blk],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+    return tuple(_unfold(x, b, L, h, d) for x in (dq, dk, dv))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, causal: bool, interpret: bool):
-    return _flash_forward(q, k, v, causal, interpret)
+    return _flash_forward(q, k, v, causal, interpret)[0]
 
 
 def _fa_fwd(q, k, v, causal, interpret):
-    return _flash_forward(q, k, v, causal, interpret), (q, k, v)
+    o, lse = _flash_forward(q, k, v, causal, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _fa_bwd(causal, interpret, residuals, g):
-    # flash-style backward: recompute attention from (q, k, v) with the
-    # reference math and differentiate through it — O(L*D) residual
-    # memory, XLA fuses the recompute into the backward matmuls
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda a, b, c: reference_attention(a, b, c, causal),
-                     q, k, v)
-    return vjp(g)
+    # two-kernel flash backward (dq; dk+dv) from O(L*D) residuals —
+    # the [L, L] score matrix is re-formed blockwise in VMEM, never
+    # materialized in HBM
+    q, k, v, o, lse = residuals
+    return _flash_backward(q, k, v, o, lse, g, causal, interpret)
 
 
 _flash_attention.defvjp(_fa_fwd, _fa_bwd)

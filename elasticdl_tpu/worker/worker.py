@@ -103,6 +103,7 @@ class Worker:
         # host-side generator for embedding lazy-init draws (see
         # lookup_embedding for why this is not jax.random)
         self._emb_init_rng = np.random.default_rng(seed + worker_id)
+        self._emb_prefetch_pool = None  # lazy: BET lookahead thread
 
         self._params = None  # trainable pytree (device)
         self._aux: Dict[str, Any] = {}  # non-trainable collections
@@ -543,6 +544,18 @@ class Worker:
             for name, spec in self._emb_specs.items()
         }
 
+    def _emb_pool(self):
+        """Single-thread executor for BET prefetch: one thread keeps
+        lookups ordered (and the lazy-init numpy Generator draws
+        single-threaded) while overlapping them with device compute."""
+        if self._emb_prefetch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._emb_prefetch_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="bet-prefetch"
+            )
+        return self._emb_prefetch_pool
+
     # ------------------------------------------------------------ jit steps
 
     def _takes_train_kwarg(self) -> bool:
@@ -836,12 +849,13 @@ class Worker:
                 self._base_flat = jnp.copy(self._flat)
                 self._base_version = self._version
 
-    def _local_minibatch(self, features, labels, task: Task):
+    def _local_minibatch(self, features, labels, task: Task, embs=None):
         self._ensure_local_ready(features, task)
         if self._emb_specs:
             if self._local_step_fn is None:
                 self._local_step_fn = self._build_local_emb_step()
-            embs = self._prepare_embeddings(features)
+            if embs is None:
+                embs = self._prepare_embeddings(features)
             bets = {k: b.bet for k, b in embs.items()}
             bet_aux = {k: (b.inverse, b.mask) for k, b in embs.items()}
             (
@@ -949,18 +963,55 @@ class Worker:
         a short final batch) fall back to the per-step path."""
         W = self._local_updates
         if self._emb_specs:
-            # embedding models step per batch inside the window (each
+            # Embedding models step per batch inside the window (each
             # batch's BET has its own bucketed shape, so windows can't
             # stack into one scan); the dense optimizer still runs on
-            # device and the sparse flush rides the window sync
+            # device and the sparse flush rides the window sync.
+            #
+            # BET PREFETCH (VERDICT r4 #5): batch N+1's row lookups +
+            # lazy-init draws run on a background thread while batch N
+            # dispatches and computes — the host-side RPC latency that
+            # otherwise serializes against device compute (the
+            # reference pays it mid-graph via py_function,
+            # embedding.py:98-125). Consistency class is unchanged: the
+            # chained window sync already allows a lookup to race the
+            # in-flight flush (bounded sparse staleness, documented in
+            # docs/scale_out_design.md); prefetch deepens that race by
+            # at most one batch. EDL_SYNC_DEPTH=0 (the serialized
+            # bit-parity mode) disables prefetch so each flush still
+            # lands before the next lookup. EDL_BET_PREFETCH=0 turns
+            # the overlap off (bench A/B knob).
+            prefetch_on = (
+                self._max_inflight_syncs > 0
+                and os.environ.get("EDL_BET_PREFETCH", "1") != "0"
+            )
+
+            def fetch(b):
+                if b is None:
+                    return None
+                if not prefetch_on:
+                    return None
+                return self._emb_pool().submit(
+                    self._prepare_embeddings, b[0]
+                )
+
             loss = None
-            while True:
+            with self.timers.phase("get_batch"):
+                batch = next(batches, None)
+            fut = fetch(batch)
+            while batch is not None:
                 with self.timers.phase("get_batch"):
-                    batch = next(batches, None)
-                if batch is None:
-                    return loss
+                    nxt = next(batches, None)
+                nxt_fut = fetch(nxt)  # in flight during N's compute
                 with self.timers.phase("compute"):
-                    loss = self._local_minibatch(batch[0], batch[1], task)
+                    loss = self._local_minibatch(
+                        batch[0],
+                        batch[1],
+                        task,
+                        embs=fut.result() if fut is not None else None,
+                    )
+                batch, fut = nxt, nxt_fut
+            return loss
         buf = []
         loss = None
         done = False
@@ -2008,6 +2059,8 @@ class Worker:
         try:
             self._finalize_local_updates()
         finally:
+            if self._emb_prefetch_pool is not None:
+                self._emb_prefetch_pool.shutdown(wait=True)
             self._readers.close()
             if self._ps is not None:
                 self._ps.close()
