@@ -1,28 +1,33 @@
-"""Fused causal attention as a Pallas TPU kernel.
+"""Fused causal attention as Pallas TPU kernels (fwd + bwd).
 
 The hot op of the flagship transformer (models/transformer_lm.py) and
 of each ring-attention step (parallel/ring_attention.py) is blockwise
 softmax(QK^T)V. XLA's stock lowering materializes the [L, L] score
-matrix in HBM for the full-sequence path; this kernel keeps everything
-in VMEM with the standard flash-attention online-softmax accumulator
-(m/l running max/denominator), so HBM traffic is O(L*D) instead of
-O(L^2) and the MXU sees back-to-back [BQ,D]x[D,BK] and [BQ,BK]x[BK,D]
-matmuls in fp32 accumulation.
+matrix in HBM for the full-sequence path; these kernels keep the
+working set in VMEM with the standard flash-attention online-softmax
+accumulator (m/l running max/denominator), so HBM traffic is O(L*D)
+instead of O(L^2) and the MXU sees back-to-back [BQ,D]x[D,BK] and
+[BQ,BK]x[BK,D] matmuls with f32 accumulation.
 
 No reference equivalent (the 2019 reference has no attention model);
 this is the "pallas kernels for the hot ops" arm of the TPU-first
-design. Both directions are Pallas kernels: the forward also emits the
-per-row logsumexp, and the backward is the standard two-kernel flash
-scheme — a dq kernel gridded over q-blocks and a dk/dv kernel gridded
-over k-blocks, each re-forming p = exp(s - lse) from the residuals so
-nothing quadratic is ever saved (FlashAttention-2 recompute layout; no
-atomics — each kernel owns its output block). Numerics are validated
+design. All three kernels (fwd, dq, dk+dv) are STREAMING: the
+non-owned sequence dimension rides the innermost grid axis — one
+[BLOCK, D] tile in flight per input, accumulators live in VMEM scratch
+across grid steps, output blocks revisit until their row/column is
+done. VMEM use is O(BLOCK*D) regardless of L (the earlier seq-resident
+layout hit Mosaic's 16M scoped-vmem wall at L=8192), which is what
+makes long-context the kernel's home regime. The forward also emits
+the per-row logsumexp; the backward is the standard two-kernel flash
+scheme re-forming p = exp(s - lse) from O(L*D) residuals — nothing
+quadratic is ever saved, and no atomics: each kernel owns its output
+block (FlashAttention-2 layout). Numerics are validated
 block-for-block against the reference math in
 tests/test_flash_attention.py, in Pallas interpret mode on CPU and
 compiled under EDL_TPU_TESTS=1 on the chip.
 
 Layout contract: [B, L, H, D] ("blhd", matching transformer_lm), any
-float dtype; compute is fp32. L must divide by the 128 block; callers
+float dtype; compute is f32. L must divide by the 128 block; callers
 with ragged L use the jnp fallback (`reference_attention`).
 """
 
@@ -34,6 +39,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 BLOCK = 128  # q/k block edge: MXU-native tile
 _NEG_INF = -1e30
@@ -59,55 +65,6 @@ def _causal_mask(qi, kj, s):
     return jnp.where(rows >= cols, s, _NEG_INF)
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, n_blocks: int,
-               causal: bool, scale: float):
-    """One q-block program: q_ref/o_ref are [1, BLOCK, D]; k_ref/v_ref
-    hold the full [1, L, D] sequence (constant across the q-block grid
-    dimension, so Mosaic keeps them resident in VMEM). fori_loop over
-    k-blocks with the flash m/l/acc online softmax; causal runs the
-    loop only up to the diagonal block and masks inside it by global
-    position. Also emits the per-row logsumexp (m + log l) — the
-    backward kernels re-form p = exp(s - lse) from it."""
-    qi = pl.program_id(1)
-    q = q_ref[0]  # [BLOCK, D], input dtype: MXU-native operands
-    d = q.shape[-1]
-
-    def body(kj, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.ds(kj * BLOCK, BLOCK), :]
-        vb = v_ref[0, pl.ds(kj * BLOCK, BLOCK), :]
-        # operands stay in the input dtype (bf16 on the hot path: the
-        # MXU's native mode), accumulation in f32 via
-        # preferred_element_type; the scale folds into f32 afterwards
-        s = jax.lax.dot_general(
-            q, kb,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [BQ, BK]
-        if causal:
-            s = _causal_mask(qi, kj, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
-            p.astype(vb.dtype), vb,  # p in operand dtype: bf16 MXU pass
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return acc, m_new, l
-
-    init = (
-        jnp.zeros((BLOCK, d), jnp.float32),
-        jnp.full((BLOCK, 1), _NEG_INF, jnp.float32),
-        jnp.zeros((BLOCK, 1), jnp.float32),
-    )
-    hi = qi + 1 if causal else n_blocks
-    acc, m, l = jax.lax.fori_loop(0, hi, body, init)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
-
-
 def _fold(x, b, L, h, d):
     return x.transpose(0, 2, 1, 3).reshape(b * h, L, d)
 
@@ -116,47 +73,112 @@ def _unfold(x, b, L, h, d):
     return x.reshape(b, h, L, d).transpose(0, 2, 1, 3)
 
 
+# ----------------------------------------------------------------- forward
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+               *, n_k: int, causal: bool, scale: float):
+    """Streaming forward: grid (bh, q-block, k-block), k innermost.
+    One [BLOCK, D] tile per input is resident; the online-softmax state
+    (acc/m/l) lives in VMEM scratch across the k sweep; o/lse write
+    once at the sweep's end (their block index is constant over kj, so
+    Mosaic keeps them in VMEM until then)."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    visible = kj <= qi if causal else kj >= 0
+
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0]  # [BQ, D], input dtype: MXU-native operands
+        kb = k_ref[0]
+        vb = v_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [BQ, BK]
+        if causal:
+            s = _causal_mask(qi, kj, s)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb,  # p in operand dtype: bf16 MXU pass
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l_ref[...])  # [BLOCK, 1]
+
+
 def _flash_forward(q, k, v, causal: bool, interpret: bool):
-    """Returns (o [B,L,H,D], lse [B*H, L])."""
+    """Returns (o [B,L,H,D], lse [B*H, L, 1])."""
     b, L, h, d = q.shape
     assert L % BLOCK == 0, f"L={L} must divide by {BLOCK}"
-    n_blocks = L // BLOCK
+    n_k = L // BLOCK
     scale = 1.0 / math.sqrt(d)
-    # [B, L, H, D] -> [B*H, L, D]; grid = (head, q-block)
+    # [B, L, H, D] -> [B*H, L, D]; grid = (head, q-block, k-block)
     qf, kf, vf = (_fold(x, b, L, h, d) for x in (q, k, v))
-    qo_spec = pl.BlockSpec((1, BLOCK, d), lambda i, j: (i, j, 0))
-    kv_spec = pl.BlockSpec((1, L, d), lambda i, j: (i, 0, 0))
-    lse_spec = pl.BlockSpec((1, BLOCK), lambda i, j: (i, j))
+    q_spec = pl.BlockSpec((1, BLOCK, d), lambda i, j, t: (i, j, 0))
+    kv_spec = pl.BlockSpec((1, BLOCK, d), lambda i, j, t: (i, t, 0))
+    # rows ([B*H, L, 1]) carry a trailing singleton so Mosaic's tiling
+    # rule holds: block (1, BLOCK, 1) -> last two dims (BLOCK, 1) are
+    # (div-by-8, equal-to-array)
+    lse_spec = pl.BlockSpec((1, BLOCK, 1), lambda i, j, t: (i, j, 0))
     out, lse = pl.pallas_call(
-        functools.partial(
-            _fa_kernel, n_blocks=n_blocks, causal=causal, scale=scale
-        ),
+        functools.partial(_fa_kernel, n_k=n_k, causal=causal, scale=scale),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, L, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, L), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, L, 1), jnp.float32),
         ],
-        grid=(b * h, n_blocks),
-        in_specs=[qo_spec, kv_spec, kv_spec],
-        out_specs=[qo_spec, lse_spec],
+        grid=(b * h, n_k, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[q_spec, lse_spec],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, d), jnp.float32),
+            pltpu.VMEM((BLOCK, 1), jnp.float32),
+            pltpu.VMEM((BLOCK, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
     return _unfold(out, b, L, h, d), lse
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               n_blocks: int, causal: bool, scale: float):
-    """dq for one q-block: loop over visible k-blocks, re-form
-    p = exp(s - lse), ds = p * (do v^T - delta) * scale, dq += ds k."""
-    qi = pl.program_id(1)
-    q = q_ref[0]  # [BQ, D]
-    do = do_ref[0]
-    lse = lse_ref[0][:, None]  # [BQ, 1]
-    delta = delta_ref[0][:, None]
-    d = q.shape[-1]
+# ---------------------------------------------------------------- backward
 
-    def body(kj, acc):
-        kb = k_ref[0, pl.ds(kj * BLOCK, BLOCK), :]
-        vb = v_ref[0, pl.ds(kj * BLOCK, BLOCK), :]
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, n_k: int, causal: bool, scale: float):
+    """Streaming dq: grid (bh, q-block, k-block), k innermost. Re-forms
+    p = exp(s - lse), ds = p * (do v^T - delta) * scale, accumulates
+    dq += ds k in VMEM scratch across the k sweep."""
+    qi, kj = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    visible = kj <= qi if causal else kj >= 0
+
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0]  # [BQ, D]
+        do = do_ref[0]
+        lse = lse_ref[0]  # [BQ, 1]
+        delta = delta_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -169,32 +191,40 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             preferred_element_type=jnp.float32,
         )
         ds = (p * (dp - delta) * scale).astype(kb.dtype)
-        return acc + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    hi = qi + 1 if causal else n_blocks
-    acc = jax.lax.fori_loop(0, hi, body, jnp.zeros((BLOCK, d), jnp.float32))
-    dq_ref[0] = acc.astype(dq_ref.dtype)
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, *, n_blocks: int, causal: bool, scale: float):
-    """dk/dv for one k-block: loop over the q-blocks that can see it
-    (qi >= kj causal); each kernel owns its output block — no
-    atomics."""
-    kj = pl.program_id(1)
-    kb = k_ref[0]  # [BK, D]
-    vb = v_ref[0]
-    d = kb.shape[-1]
+                dv_ref, dk_acc, dv_acc, *, n_q: int, causal: bool,
+                scale: float):
+    """Streaming dk/dv: grid (bh, k-block, q-block), q innermost. The
+    owned k/v tiles stay resident (their index is constant over qi);
+    q/do/lse/delta tiles stream past; dk/dv accumulate in VMEM scratch.
+    No atomics — this kernel owns its k-block's outputs."""
+    kj, qi = pl.program_id(1), pl.program_id(2)
 
-    def body(qi, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(qi * BLOCK, BLOCK), :]
-        do = do_ref[0, pl.ds(qi * BLOCK, BLOCK), :]
-        lse = lse_ref[0, pl.ds(qi * BLOCK, BLOCK)][:, None]
-        delta = delta_ref[0, pl.ds(qi * BLOCK, BLOCK)][:, None]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    visible = qi >= kj if causal else qi >= 0
+
+    @pl.when(visible)
+    def _body():
+        kb = k_ref[0]  # [BK, D]
+        vb = v_ref[0]
+        qb = q_ref[0]  # [BQ, D]
+        do = do_ref[0]
+        lse = lse_ref[0]  # [BQ, 1]
+        delta = delta_ref[0]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -202,7 +232,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         if causal:
             s = _causal_mask(qi, kj, s)
         p = jnp.exp(s - lse)  # [BQ, BK]
-        dv = dv + jax.lax.dot_general(
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -211,57 +241,59 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             preferred_element_type=jnp.float32,
         )
         ds = (p * (dp - delta) * scale).astype(qb.dtype)
-        dk = dk + jax.lax.dot_general(
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
-    lo = kj if causal else 0
-    dk, dv = jax.lax.fori_loop(
-        lo,
-        n_blocks,
-        body,
-        (
-            jnp.zeros((BLOCK, d), jnp.float32),
-            jnp.zeros((BLOCK, d), jnp.float32),
-        ),
-    )
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, g, causal: bool, interpret: bool):
     b, L, h, d = q.shape
     n_blocks = L // BLOCK
     scale = 1.0 / math.sqrt(d)
-    qf, kf, vf, of, gf = (_fold(x, b, L, h, d) for x in (q, k, v, o, g))
+    qf, kf, vf, gf = (_fold(x, b, L, h, d) for x in (q, k, v, g))
+    of = _fold(o, b, L, h, d)
     # delta_i = rowsum(do_i * o_i): tiny elementwise+reduce, XLA fuses
     delta = jnp.sum(
-        gf.astype(jnp.float32) * of.astype(jnp.float32), axis=-1
-    )  # [B*H, L]
-    blk = pl.BlockSpec((1, BLOCK, d), lambda i, j: (i, j, 0))
-    seq = pl.BlockSpec((1, L, d), lambda i, j: (i, 0, 0))
-    row_blk = pl.BlockSpec((1, BLOCK), lambda i, j: (i, j))
-    row_seq = pl.BlockSpec((1, L), lambda i, j: (i, 0))
-    kw = dict(n_blocks=n_blocks, causal=causal, scale=scale)
+        gf.astype(jnp.float32) * of.astype(jnp.float32),
+        axis=-1,
+        keepdims=True,
+    )  # [B*H, L, 1] — trailing singleton for the tiling rule
+    own = pl.BlockSpec((1, BLOCK, d), lambda i, j, t: (i, j, 0))
+    stream = pl.BlockSpec((1, BLOCK, d), lambda i, j, t: (i, t, 0))
+    row_own = pl.BlockSpec((1, BLOCK, 1), lambda i, j, t: (i, j, 0))
+    row_stream = pl.BlockSpec((1, BLOCK, 1), lambda i, j, t: (i, t, 0))
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **kw),
+        functools.partial(
+            _dq_kernel, n_k=n_blocks, causal=causal, scale=scale
+        ),
         out_shape=jax.ShapeDtypeStruct((b * h, L, d), q.dtype),
-        grid=(b * h, n_blocks),
-        in_specs=[blk, seq, seq, blk, row_blk, row_blk],
-        out_specs=blk,
+        grid=(b * h, n_blocks, n_blocks),
+        in_specs=[own, stream, stream, own, row_own, row_own],
+        out_specs=own,
+        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **kw),
+        functools.partial(
+            _dkv_kernel, n_q=n_blocks, causal=causal, scale=scale
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, L, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, L, d), v.dtype),
         ],
-        grid=(b * h, n_blocks),
-        in_specs=[seq, blk, blk, seq, row_seq, row_seq],
-        out_specs=[blk, blk],
+        grid=(b * h, n_blocks, n_blocks),
+        in_specs=[stream, own, own, stream, row_stream, row_stream],
+        out_specs=[own, own],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, d), jnp.float32),
+            pltpu.VMEM((BLOCK, d), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
     return tuple(_unfold(x, b, L, h, d) for x in (dq, dk, dv))
@@ -295,26 +327,33 @@ def flash_attention(q, k, v, causal: bool = True, interpret: bool = False):
     return _flash_attention(q, k, v, causal, interpret)
 
 
+# Auto-engage threshold: estimated bytes of the materialized scores
+# (+backward copies) beyond which XLA's [L,L] path approaches the
+# 16G HBM and the O(L*D) kernels take over. Chip-measured A/B
+# (docs/performance.md): XLA's fused attention is FASTER wherever its
+# quadratic working set fits (2-2.5x at L<=16k, b1 h8 d64 — head
+# batching beats the per-head grid), and hard-OOMs at L=32k (34G
+# needed) where the kernels run fine — the kernels are the
+# long-context ENABLER, not a short-sequence speedup.
+FLASH_SCORE_BYTES = 6e9
+
+
 def attention(q, k, v, causal: bool = True):
     """Dispatcher, the single entry point for model code.
 
-    The Pallas kernel engages on TPU (block-divisible L) when
-    EDL_TPU_FLASH=1. It is opt-in rather than default because of a
-    measured platform fact, not kernel quality: on this build's
-    remote-TPU tunnel every pallas_call launch pays a full host
-    round-trip (~80ms — launches do not pipeline like XLA ops, so a
-    10-iteration loop costs 10 RTTs regardless of L), while XLA's own
-    attention fusion runs 8-18ms/iter fully pipelined. On a co-located
-    TPU-VM there is no tunnel and the kernel's O(L*D) HBM story wins
-    at long L; flip the flag there. Numerics are identical either way
+    On TPU the Pallas kernels engage automatically when the estimated
+    quadratic working set of XLA's materializing path would crowd HBM
+    (see FLASH_SCORE_BYTES); otherwise XLA's fused attention runs —
+    measured faster wherever it fits. EDL_TPU_FLASH=1 forces the
+    kernels on for any block-divisible L, EDL_TPU_FLASH=0 forces them
+    off. Numerics are identical either way
     (tests/test_flash_attention.py)."""
     import os
 
-    L = q.shape[1]
-    if (
-        os.environ.get("EDL_TPU_FLASH") == "1"
-        and jax.default_backend() == "tpu"
-        and L % BLOCK == 0
-    ):
-        return flash_attention(q, k, v, causal)
+    b, L, h, _d = q.shape
+    flag = os.environ.get("EDL_TPU_FLASH")
+    if jax.default_backend() == "tpu" and L % BLOCK == 0 and flag != "0":
+        score_bytes = 2.5 * b * h * L * L * 2  # bf16 probs, fwd+bwd copies
+        if flag == "1" or score_bytes > FLASH_SCORE_BYTES:
+            return flash_attention(q, k, v, causal)
     return reference_attention(q, k, v, causal)
