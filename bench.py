@@ -187,7 +187,10 @@ def main():
     # pass the convergence gate — a throughput number from a diverged
     # run is not a headline.
     attempts = []
-    link_mbps = []  # h2d MB/s measured right before each run (weather)
+    link_mbps = []  # h2d MB/s bracketing each run: max(before, after) —
+    # a single instantaneous probe can miss the run's real weather (the
+    # link swings within seconds; measured: probe 40 MB/s immediately
+    # before the FASTEST run of a pair)
     tail = None
     max_attempts = 2 if on_tpu else 1
     attempt = 0
@@ -195,7 +198,7 @@ def main():
         if on_tpu:
             from bench_resnet import measure_link_bandwidth
 
-            link_mbps.append(round(measure_link_bandwidth(), 1))
+            link_before = measure_link_bandwidth()
         imgs_per_sec, worker, elapsed = run_job(
             model_module,
             path,
@@ -216,6 +219,10 @@ def main():
         # of the last 3 tasks, so one lucky final window can't pass an
         # oscillating run. TPU only: the CPU smoke run is 16 steps,
         # all inside the 200-step LR warmup.
+        if on_tpu:
+            link_mbps.append(
+                round(max(link_before, measure_link_bandwidth()), 1)
+            )
         losses = worker.task_losses
         assert losses, "no training tasks ran"
         run_tail = statistics.median(losses[-3:])
@@ -423,8 +430,10 @@ def main():
                     "convergence (window_runs_images_per_sec lists "
                     "both; the shared accelerator link swings "
                     "several-fold between minutes — link_mbps_per_run "
-                    "records the h2d bandwidth measured immediately "
-                    "before each run, and "
+                    "records max(h2d bandwidth probed immediately "
+                    "before, immediately after) each run (a single "
+                    "instantaneous probe can miss the run's real "
+                    "weather), and "
                     "window_imgs_per_sec_per_link_mbps is the "
                     "weather-normalized secondary: the window protocol "
                     "is link-bound here, so compare THAT ratio across "
