@@ -144,15 +144,12 @@ def run_job(
             # the framework's --compile_cache_dir feature: replacements
             # and standbys reuse the incumbents' compiled programs
             **resolve_compile_cache_envs(args),
-            # Sync depth stays at the framework default: depth 0 was
-            # measured WORSE here (the serialized chain amplifies
-            # contention during churn), and in-flight exposure is
-            # already bounded by the short windows above.
-            **(
-                {"EDL_SYNC_DEPTH": os.environ["EDL_ELASTIC_BENCH_DEPTH"]}
-                if os.environ.get("EDL_ELASTIC_BENCH_DEPTH")
-                else {}
-            ),
+            # Sync depth stays at the framework default (workers
+            # inherit the bench environment, so EDL_SYNC_DEPTH set on
+            # the bench reaches them): depth 0 was measured WORSE here
+            # (the serialized chain amplifies contention during
+            # churn), and in-flight exposure is already bounded by the
+            # short windows above.
         },
         max_relaunches=2 * N_WORKERS,
         num_standby=standby,
@@ -314,7 +311,7 @@ def main():
         # produced a 42% stable swing between seeds in a run where the
         # CHURN numbers agreed to 0.4% — the ratio's variance was all
         # baseline. 6+ epochs puts the stable window in the minutes.
-        stable_epochs = max(epochs, 6 if small_host else epochs)
+        stable_epochs = max(epochs, 6)
         stable_ips, _, boot_secs, _, _ = run_job(
             tmp, n_records, churn=False, epochs=stable_epochs,
             cache_dir=cache_dir, standby=standby,
@@ -423,8 +420,9 @@ def main():
                     "churn throughput, and the churn window is sized >= "
                     f"{BOOT_AMORTIZATION:g}x the measured boot so the "
                     "transients carry the weight they have in a "
-                    "long-running job. Windows are 2 steps x 64 "
-                    "records: preemption loses the current un-flushed "
+                    f"long-running job. Windows are {LOCAL_UPDATES} steps "
+                    f"x {MINIBATCH} records: "
+                    "preemption loses the current un-flushed "
                     "window, so window size is itself an elastic "
                     "design axis — short windows bound loss-per-kill, "
                     "and the sync frequency they cost is sub-ms "
