@@ -95,6 +95,54 @@ def test_evaluation_job_mixes_scalars_and_states():
     assert abs(metrics["auc"] - _exact_auc(scores, labels)) < 0.01
 
 
+def test_mergeable_auc_rides_the_real_wire(tmp_path):
+    """End-to-end over real gRPC: a deepfm training+evaluation job
+    whose AUC metric is mergeable STATE — the worker's per-batch dict
+    of arrays must survive the codec, the servicer's report handler,
+    and the eval service's merge, and finalize to a sane job AUC.
+    (The unit tests above cover the math; this covers the wire.)"""
+    from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.models import deepfm_edl_embedding
+    from elasticdl_tpu.models.record_codec import (
+        write_synthetic_tabular_records,
+    )
+    from elasticdl_tpu.rpc.client import RpcClient
+    from elasticdl_tpu.rpc.server import RpcServer
+    from elasticdl_tpu.testing import build_job
+    from elasticdl_tpu.worker.worker import Worker
+
+    train = str(tmp_path / "train.rio")
+    evalp = str(tmp_path / "eval.rio")
+    write_synthetic_tabular_records(
+        train, 128, deepfm_edl_embedding.NUM_FIELDS, 100
+    )
+    write_synthetic_tabular_records(
+        evalp, 64, deepfm_edl_embedding.NUM_FIELDS, 100, seed=1
+    )
+    dispatcher = TaskDispatcher({train: 128}, {evalp: 64}, {}, 32, 2)
+    spec = spec_from_module(deepfm_edl_embedding)
+    servicer, eval_service, _ckpt = build_job(
+        spec, dispatcher, grads_to_wait=1, eval_steps=2
+    )
+    server = RpcServer(servicer.handlers(), port=0)
+    server.start()
+    try:
+        client = RpcClient(f"localhost:{server.port}")
+        client.wait_ready(10)
+        worker = Worker(0, client, spec, minibatch_size=32, local_updates=2)
+        assert worker.run()
+        worker.close()
+        assert dispatcher.finished()
+        assert eval_service.completed_metrics, "no eval jobs completed"
+        for _version, metrics in eval_service.completed_metrics:
+            assert isinstance(metrics["auc"], float)  # finalized scalar
+            assert 0.0 <= metrics["auc"] <= 1.0
+            assert 0.0 <= metrics["accuracy"] <= 1.0
+    finally:
+        server.stop()
+
+
 def test_auc_state_degenerate_single_class():
     st = {k: np.asarray(v) for k, v in auc_state(np.ones(8), np.ones(8)).items()}
     assert finalize_metric_state(st) == 0.5
