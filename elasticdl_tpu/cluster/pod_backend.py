@@ -104,6 +104,20 @@ class ProcessBackend(PodBackend):
     def start_worker(self, worker_id: int, argv: List[str], envs: Dict[str, str]):
         env = dict(os.environ) if self._inherit_env else {}
         env.update(envs)
+        if env.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # A CPU pin must be REAL: this image's sitecustomize
+            # registers a remote accelerator platform (and a
+            # remote-compile path) in every python process when its
+            # env triggers are present, regardless of JAX_PLATFORMS.
+            # Measured failure: with the remote terminal restarted,
+            # spawned CPU workers' jits came back as AOT executables
+            # compiled on the terminal's (different) machine — foreign
+            # machine features, SIGILL/hang territory. Stripping the
+            # triggers makes CPU workers hermetic: local XLA:CPU
+            # compiles, no tunnel dependence.
+            for k in list(env):
+                if k.startswith("PALLAS_AXON") or k.startswith("AXON_"):
+                    env.pop(k)
         # the package must be importable regardless of the child's cwd
         import elasticdl_tpu
 

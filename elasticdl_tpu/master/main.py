@@ -366,6 +366,18 @@ def make_backend(args):
 
 
 def main(argv=None) -> int:
+    # The image's sitecustomize force-registers a remote accelerator
+    # platform in every python process; an explicit cpu request needs
+    # the config update too, or the master's OWN jax ops (PS optimizer
+    # applies, checkpoint assembly) initialize the remote backend — and
+    # hang the whole job when the remote tunnel is sick. The worker
+    # entrypoint has carried this guard since round 3; the master
+    # needed it too (measured: worker reports wedged on the master's
+    # first apply with ~0 CPU on both sides).
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     args = master_parser().parse_args(argv)
     try:
         job_type = validate_master_args(args)
