@@ -164,6 +164,23 @@ def runtime_throughput(window=32, minibatch=128, n_records=32768):
 
 
 def main():
+    # TPU liveness first (see bench._tpu_alive): a wedged tunnel hangs
+    # jax backend initialization itself, so probe from env alone in a
+    # subprocess before touching any backend here
+    import os as _os
+
+    if (
+        _os.environ.get("JAX_PLATFORMS", "").strip() != "cpu"
+        and _os.environ.get("PALLAS_AXON_POOL_IPS")
+    ):
+        from bench import _tpu_alive
+
+        if not _tpu_alive():
+            print(
+                "bench: TPU unreachable; running the CPU smoke protocol",
+                file=sys.stderr,
+            )
+            _os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
