@@ -127,6 +127,12 @@ class ProcessBackend(PodBackend):
             if env.get("PYTHONPATH")
             else pkg_root
         )
+        # chaos scoping: tag the child so an inherited EDL_CHAOS_SPEC
+        # applies with role/target filters (inert when chaos is off) —
+        # and so a spec aimed at workers never fires inside the master
+        from elasticdl_tpu.rpc.chaos import chaos_env_for
+
+        env.update(chaos_env_for("worker", worker_id))
         cmd = [sys.executable, "-m", self._worker_module] + list(argv)
         stdout = stderr = None
         log_path = ""

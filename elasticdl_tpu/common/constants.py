@@ -19,6 +19,13 @@ SERVICE_NAME = "elasticdl_tpu.Master"
 # WorkerManager must NOT relaunch a worker that exits with it.
 EXIT_CODE_JOB_FAILED = 2
 
+# Worker exit code for "master unreachable past the retry budget":
+# graceful degradation instead of a hang — distinct from a crash (1)
+# so operators can tell a network partition from a worker bug, while
+# the WorkerManager still treats it as relaunch-eligible (the master
+# may have moved / recovered by relaunch time).
+EXIT_CODE_MASTER_UNREACHABLE = 3
+
 
 class WorkerManagerStatus(object):
     PENDING = "Pending"

@@ -81,6 +81,9 @@ class PSShardGroup:
         self._boot_timeout = boot_timeout
         self.endpoints: List[str] = []
         self._servers = []  # inproc RpcServers
+        # inproc servicer refs: tests/operators read stats() (e.g. the
+        # chaos e2e asserts the dedup ring absorbed retried pushes)
+        self.servicers = []
         self._procs: List[subprocess.Popen] = []
         self._k8s_created = 0  # pods created (>= endpoints resolved)
         self._client: Optional[ShardedPS] = None
@@ -157,6 +160,7 @@ class PSShardGroup:
             )
             server = RpcServer(servicer.handlers(), port=0)
             server.start()
+            self.servicers.append(servicer)
             self._servers.append(server)
             self.endpoints.append(f"localhost:{server.port}")
 
@@ -178,6 +182,7 @@ class PSShardGroup:
         for s in self._servers:
             s.stop()
         self._servers = []
+        self.servicers = []
         # delete every CREATED pod, not only resolved endpoints — a
         # partially-booted group (IP wait timed out) must not leak pods
         for i in range(self._k8s_created):

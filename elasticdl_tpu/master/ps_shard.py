@@ -105,6 +105,11 @@ class PSShardServicer:
         # the torn-report window to hard shard death (ADVICE r3 #2).
         self._seen_reports: "OrderedDict[str, None]" = OrderedDict()
         self._seen_cap = 512
+        # observability: chaos tests assert the dedup ring actually
+        # absorbed retried pushes (a dropped-response retry MUST land
+        # here, not double-apply)
+        self._duplicate_pushes = 0
+        self._applied_pushes = 0
 
     # -- handler table -------------------------------------------------------
 
@@ -258,17 +263,32 @@ class PSShardServicer:
 
     # -- internals -----------------------------------------------------------
 
+    def stats(self) -> Dict[str, int]:
+        """Push accounting (exactness evidence for the chaos tests):
+        `applied_pushes` counts pushes that mutated state,
+        `duplicate_pushes` counts retried resends the dedup ring
+        absorbed. applied + duplicate == pushes received."""
+        with self._lock:
+            return {
+                "applied_pushes": self._applied_pushes,
+                "duplicate_pushes": self._duplicate_pushes,
+                "version": self._version,
+            }
+
     def _is_duplicate(self, req: dict) -> bool:
         """Record req's report_key; True if it was already applied
         (caller holds the lock). Keyless pushes are never deduped."""
         key = req.get("report_key")
         if not key:
+            self._applied_pushes += 1
             return False
         if key in self._seen_reports:
+            self._duplicate_pushes += 1
             return True
         self._seen_reports[key] = None
         while len(self._seen_reports) > self._seen_cap:
             self._seen_reports.popitem(last=False)
+        self._applied_pushes += 1
         return False
 
     def _wire_vec(self, req: dict) -> np.ndarray:

@@ -48,6 +48,12 @@ def spawn_shard_processes(
         # accelerator (the entrypoints also pin the backend themselves —
         # the image's sitecustomize overrides the env var)
         env["JAX_PLATFORMS"] = "cpu"
+        # chaos scoping: "ps"/"kv" role + shard id for an inherited
+        # EDL_CHAOS_SPEC (inert when chaos is off)
+        from elasticdl_tpu.rpc.chaos import chaos_env_for
+
+        role = "kv" if "kv" in entry_module.rsplit(".", 1)[-1] else "ps"
+        env.update(chaos_env_for(role, i))
         import elasticdl_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(elasticdl_tpu.__file__))

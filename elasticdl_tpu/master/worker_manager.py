@@ -32,7 +32,10 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from elasticdl_tpu.cluster.pod_backend import PodBackend, PodEvent, PodPhase
-from elasticdl_tpu.common.constants import EXIT_CODE_JOB_FAILED
+from elasticdl_tpu.common.constants import (
+    EXIT_CODE_JOB_FAILED,
+    EXIT_CODE_MASTER_UNREACHABLE,
+)
 from elasticdl_tpu.common.log_util import get_logger
 
 logger = get_logger(__name__)
@@ -143,6 +146,16 @@ class WorkerManager:
         completed = event.phase == PodPhase.SUCCEEDED or (
             event.exit_code == EXIT_CODE_JOB_FAILED
         )
+        if done and event.exit_code == EXIT_CODE_MASTER_UNREACHABLE:
+            # the worker degraded gracefully on a partitioned/restarted
+            # control plane; by relaunch time the endpoint may be back —
+            # explicitly relaunch-eligible (completed stays False)
+            logger.warning(
+                "Worker %d exited %d (RPC peer unreachable); "
+                "treating as relaunch-eligible",
+                event.worker_id,
+                event.exit_code,
+            )
         with self._lock:
             # dedupe: the k8s watch re-delivers existing pod states on
             # every stream restart; a worker already terminal must not

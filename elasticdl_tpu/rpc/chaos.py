@@ -1,0 +1,350 @@
+"""Deterministic fault injection (chaos) for the RPC plane.
+
+The framework's whole value proposition is surviving failure — flaky
+networks, slow shards, processes dying mid-step — yet nothing in-tree
+could *produce* those failures on demand, so the recovery machinery
+(RetryPolicy, report_key dedup, task requeue, standby promotion) was
+only exercised by happy-path tests. This module injects the failures,
+deterministically, at the gRPC interceptor layer, so production code
+paths run UNCHANGED under fault.
+
+A `FaultPlan` is a seeded list of fault entries:
+
+- ``latency``: sleep `latency_ms` before forwarding the call;
+- ``error``: raise/abort with UNAVAILABLE or DEADLINE_EXCEEDED
+  *instead of* running the call (client side: before the request is
+  sent — the server never sees it);
+- ``drop``: run the call to completion (the server APPLIES it), then
+  discard the response and surface UNAVAILABLE — the nastiest real
+  failure shape, the one report_key dedup exists for;
+- ``crash``: `os._exit` the process on the Nth matching call, before
+  or after the call runs; `once_file` (created O_CREAT|O_EXCL) makes
+  the crash one-shot ACROSS processes, so a relaunched replacement
+  doesn't crash again.
+
+Entries select traffic by method name, side (client/server), process
+role and target id. Role/target scoping exists because the spec
+travels by environment variable: `EDL_CHAOS_SPEC` (inline JSON or
+``@/path/to/file.json``) is inherited by every subprocess the cluster
+spawns — PS/KV shard processes, ProcessBackend workers — and each of
+those processes is tagged with `EDL_CHAOS_ROLE` (worker/ps/kv/master)
+and `EDL_CHAOS_TARGET_ID` by its spawner (cluster/pod_backend.py,
+master/shard_host.py). RpcClient/RpcServer read the env at
+construction, so chaos reaches every plane with no code changes at the
+call sites.
+
+Firing is deterministic: probabilistic entries hash
+(seed, method, match_count) — same spec + same call sequence => same
+faults, no wall clock, no shared RNG.
+
+Spec shape::
+
+    {"seed": 7, "faults": [
+      {"kind": "latency", "methods": ["PSPull"], "roles": ["worker"],
+       "side": "client", "prob": 0.5, "latency_ms": 20},
+      {"kind": "error", "code": "UNAVAILABLE", "methods": ["PSPushGrad"],
+       "side": "client", "every": 5, "max_fires": 3},
+      {"kind": "drop", "methods": ["PSPushDelta"], "side": "client",
+       "nth": 2},
+      {"kind": "crash", "methods": ["GetTask"], "roles": ["worker"],
+       "side": "client", "nth": 2, "when": "after",
+       "once_file": "/tmp/job/crash.once"}
+    ]}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import grpc
+
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.rpc.policy import PolicyRpcError
+
+logger = get_logger(__name__)
+
+ENV_SPEC = "EDL_CHAOS_SPEC"
+ENV_ROLE = "EDL_CHAOS_ROLE"
+ENV_TARGET = "EDL_CHAOS_TARGET_ID"
+
+#: exit code used by `crash` faults: distinct from clean exits (0),
+#: crashes (1), EXIT_CODE_JOB_FAILED (2) and EXIT_CODE_MASTER_UNREACHABLE
+#: (3) so logs attribute the death to chaos, while still being
+#: relaunch-eligible in the WorkerManager (any non-{0,2} exit is).
+CHAOS_CRASH_EXIT_CODE = 117
+
+_CODES = {
+    "UNAVAILABLE": grpc.StatusCode.UNAVAILABLE,
+    "DEADLINE_EXCEEDED": grpc.StatusCode.DEADLINE_EXCEEDED,
+}
+
+
+class InjectedRpcError(PolicyRpcError):
+    """Client-side injected failure (details carry the 'chaos:' tag)."""
+
+
+@dataclass
+class Fault:
+    kind: str  # latency | error | drop | crash
+    methods: Tuple[str, ...] = ()  # empty = every method
+    roles: Tuple[str, ...] = ()  # empty = every role
+    targets: Tuple[str, ...] = ()  # empty = every target id
+    side: str = "client"  # client | server | both
+    prob: float = 1.0
+    every: int = 0  # fire on every Nth matching call
+    nth: int = 0  # fire exactly on the Nth matching call
+    max_fires: int = 0  # 0 = unlimited
+    latency_ms: float = 0.0
+    code: str = "UNAVAILABLE"
+    when: str = "before"  # crash: before | after the call runs
+    once_file: str = ""  # cross-process one-shot latch for crash
+    # runtime state (not part of the spec)
+    _count: int = field(default=0, repr=False)
+    _fires: int = field(default=0, repr=False)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fault":
+        kind = d.get("kind")
+        if kind not in ("latency", "error", "drop", "crash"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        if kind == "error" and d.get("code", "UNAVAILABLE") not in _CODES:
+            raise ValueError(f"uninjectable status code {d['code']!r}")
+        return cls(
+            kind=kind,
+            methods=tuple(d.get("methods") or ()),
+            roles=tuple(d.get("roles") or ()),
+            targets=tuple(str(t) for t in (d.get("targets") or ())),
+            side=d.get("side", "client"),
+            prob=float(d.get("prob", 1.0)),
+            every=int(d.get("every", 0)),
+            nth=int(d.get("nth", 0)),
+            max_fires=int(d.get("max_fires", 0)),
+            latency_ms=float(d.get("latency_ms", 0.0)),
+            code=d.get("code", "UNAVAILABLE"),
+            when=d.get("when", "before"),
+            once_file=d.get("once_file", ""),
+        )
+
+
+class FaultPlan:
+    """A parsed chaos spec bound to this process's role/target."""
+
+    def __init__(
+        self,
+        faults: Sequence[Fault],
+        seed: int = 0,
+        role: str = "",
+        target_id: str = "",
+    ):
+        self.faults = list(faults)
+        self.seed = seed
+        self.role = role
+        self.target_id = target_id
+        self._lock = threading.Lock()
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_spec(
+        cls, spec: dict, role: str = "", target_id: str = ""
+    ) -> "FaultPlan":
+        return cls(
+            faults=[Fault.from_dict(f) for f in spec.get("faults", [])],
+            seed=int(spec.get("seed", 0)),
+            role=role,
+            target_id=target_id,
+        )
+
+    @classmethod
+    def from_env(cls, env=None) -> Optional["FaultPlan"]:
+        """The env-var activation path (None when chaos is off)."""
+        env = os.environ if env is None else env
+        raw = env.get(ENV_SPEC, "").strip()
+        if not raw:
+            return None
+        try:
+            if raw.startswith("@"):
+                with open(raw[1:]) as f:
+                    raw = f.read()
+            spec = json.loads(raw)
+            return cls.from_spec(
+                spec,
+                role=env.get(ENV_ROLE, ""),
+                target_id=env.get(ENV_TARGET, ""),
+            )
+        except Exception:
+            # a malformed spec must never take down a training process;
+            # chaos silently off beats chaos-induced config outages
+            logger.exception("ignoring malformed %s", ENV_SPEC)
+            return None
+
+    # -- firing logic --------------------------------------------------------
+
+    def _det_unit(self, fault_index: int, method: str, count: int) -> float:
+        h = hashlib.sha256(
+            f"{self.seed}:{fault_index}:{method}:{count}".encode()
+        ).digest()
+        return int.from_bytes(h[:8], "big") / 2**64  # [0, 1)
+
+    def actions_for(self, method: str, side: str) -> List[Fault]:
+        """Faults that fire on this call (advances matching counters)."""
+        fired: List[Fault] = []
+        with self._lock:
+            for idx, f in enumerate(self.faults):
+                if f.side != "both" and f.side != side:
+                    continue
+                if f.methods and method not in f.methods:
+                    continue
+                if f.roles and self.role not in f.roles:
+                    continue
+                if f.targets and self.target_id not in f.targets:
+                    continue
+                f._count += 1
+                if f.max_fires and f._fires >= f.max_fires:
+                    continue
+                if f.nth:
+                    fire = f._count == f.nth
+                elif f.every:
+                    fire = f._count % f.every == 0
+                else:
+                    fire = (
+                        f.prob >= 1.0
+                        or self._det_unit(idx, method, f._count) < f.prob
+                    )
+                if fire and f.once_file:
+                    fire = _claim_once(f.once_file)
+                if fire:
+                    f._fires += 1
+                    fired.append(f)
+        return fired
+
+    # -- interceptor factories -----------------------------------------------
+
+    def client_interceptors(self) -> List[grpc.UnaryUnaryClientInterceptor]:
+        return [_ClientChaosInterceptor(self)]
+
+    def server_interceptors(self) -> List[grpc.ServerInterceptor]:
+        return [_ServerChaosInterceptor(self)]
+
+
+def _claim_once(path: str) -> bool:
+    """Cross-process one-shot latch: True for exactly one claimant."""
+    try:
+        os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+        return True
+    except FileExistsError:
+        return False
+    except OSError:
+        logger.exception("chaos once_file %s unusable; not firing", path)
+        return False
+
+
+def _method_name(full: str) -> str:
+    # "/elasticdl_tpu.Master/PSPushGrad" -> "PSPushGrad"
+    return full.rsplit("/", 1)[-1]
+
+
+def _crash(method: str, when: str):
+    logger.error("chaos: crashing process (%s %s)", when, method)
+    # bypass atexit/finally on purpose: a SIGKILLed pod doesn't clean up
+    os._exit(CHAOS_CRASH_EXIT_CODE)
+
+
+class _ClientChaosInterceptor(grpc.UnaryUnaryClientInterceptor):
+    def __init__(self, plan: FaultPlan):
+        self._plan = plan
+
+    def intercept_unary_unary(self, continuation, client_call_details, request):
+        method = _method_name(client_call_details.method)
+        fired = self._plan.actions_for(method, "client")
+        after: List[Fault] = []
+        for f in fired:
+            if f.kind == "latency":
+                logger.info(
+                    "chaos: +%.0fms latency on %s", f.latency_ms, method
+                )
+                time.sleep(f.latency_ms / 1000.0)
+            elif f.kind == "crash" and f.when == "before":
+                _crash(method, "before")
+            elif f.kind == "error":
+                logger.info("chaos: injecting %s on %s", f.code, method)
+                raise InjectedRpcError(_CODES[f.code], f"chaos: {method}")
+            elif f.kind in ("drop", "crash"):
+                after.append(f)
+        outcome = continuation(client_call_details, request)
+        if after:
+            # force completion first: a drop/crash-after must happen
+            # with the call APPLIED server-side, or it degenerates into
+            # an error-before (the easy failure shape)
+            outcome.result()
+            for f in after:
+                if f.kind == "crash":
+                    _crash(method, "after")
+            logger.info("chaos: dropping response of %s", method)
+            raise InjectedRpcError(
+                grpc.StatusCode.UNAVAILABLE, f"chaos drop: {method}"
+            )
+        return outcome
+
+
+class _ServerChaosInterceptor(grpc.ServerInterceptor):
+    def __init__(self, plan: FaultPlan):
+        self._plan = plan
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        method = _method_name(handler_call_details.method)
+        plan = self._plan
+        inner = handler.unary_unary
+
+        def wrapped(request, context):
+            fired = plan.actions_for(method, "server")
+            after: List[Fault] = []
+            for f in fired:
+                if f.kind == "latency":
+                    logger.info(
+                        "chaos: +%.0fms latency on %s", f.latency_ms, method
+                    )
+                    time.sleep(f.latency_ms / 1000.0)
+                elif f.kind == "crash" and f.when == "before":
+                    _crash(method, "before")
+                elif f.kind == "error":
+                    logger.info("chaos: aborting %s with %s", method, f.code)
+                    context.abort(_CODES[f.code], f"chaos: {method}")
+                elif f.kind in ("drop", "crash"):
+                    after.append(f)
+            resp = inner(request, context)
+            for f in after:
+                if f.kind == "crash":
+                    _crash(method, "after")
+            if after:
+                # handler ran (state applied); response withheld
+                logger.info("chaos: dropping response of %s", method)
+                context.abort(
+                    grpc.StatusCode.UNAVAILABLE, f"chaos drop: {method}"
+                )
+            return resp
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
+def chaos_env_for(role: str, target_id: Optional[object] = None) -> Dict[str, str]:
+    """Env tags a spawner stamps onto a child process so the inherited
+    EDL_CHAOS_SPEC applies with the right role/target scoping. Cheap and
+    unconditional: the tags are inert when no spec is set."""
+    env = {ENV_ROLE: role}
+    if target_id is not None:
+        env[ENV_TARGET] = str(target_id)
+    return env

@@ -1,35 +1,86 @@
 """Generic gRPC client: method-name-addressed unary calls with the
 pytree codec (see rpc/server.py). Replaces the generated MasterStub
-(reference: elasticdl/python/worker/main.py:88-97)."""
+(reference: elasticdl/python/worker/main.py:88-97).
+
+Failure handling is centralized here: every call runs under the shared
+`RetryPolicy` (idempotent methods retry UNAVAILABLE/DEADLINE_EXCEEDED
+with deterministic backoff inside the caller's deadline budget) behind
+a per-endpoint `CircuitBreaker`, and the channel is wrapped with the
+process's chaos interceptors when `EDL_CHAOS_SPEC` is set — so fault
+injection exercises exactly the production path (see rpc/chaos.py,
+docs/fault_model.md).
+"""
 
 from __future__ import annotations
 
-from typing import Any
+import threading
+from typing import Any, Optional
 
 import grpc
 
 from elasticdl_tpu.common import messages
 from elasticdl_tpu.common.constants import GRPC_OPTIONS, SERVICE_NAME
+from elasticdl_tpu.rpc import chaos
+from elasticdl_tpu.rpc.policy import (
+    IDEMPOTENT_METHODS,
+    CircuitBreaker,
+    RetryPolicy,
+)
 
 
 class RpcClient:
-    def __init__(self, addr: str, service_name: str = SERVICE_NAME):
-        self._channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+    def __init__(
+        self,
+        addr: str,
+        service_name: str = SERVICE_NAME,
+        policy: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        fault_plan: Optional[chaos.FaultPlan] = None,
+    ):
+        channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+        plan = fault_plan if fault_plan is not None else chaos.FaultPlan.from_env()
+        if plan is not None:
+            interceptors = plan.client_interceptors()
+            if interceptors:
+                channel = grpc.intercept_channel(channel, *interceptors)
+        self._channel = channel
         self._service = service_name
+        self._policy = policy if policy is not None else RetryPolicy.from_env()
+        self._breaker = breaker if breaker is not None else CircuitBreaker(addr)
         self._calls: dict[str, Any] = {}
+        # worker threads race on the first call of each method; the
+        # memoization dict insert must be atomic
+        self._calls_lock = threading.Lock()
 
     def wait_ready(self, timeout: float = 30.0):
         grpc.channel_ready_future(self._channel).result(timeout=timeout)
 
-    def call(self, method: str, request: Any = None, timeout: float = 300.0) -> Any:
-        if method not in self._calls:
-            self._calls[method] = self._channel.unary_unary(
-                f"/{self._service}/{method}",
-                request_serializer=None,
-                response_deserializer=None,
-            )
+    def call(
+        self,
+        method: str,
+        request: Any = None,
+        timeout: float = 300.0,
+        idempotent: Optional[bool] = None,
+    ) -> Any:
+        with self._calls_lock:
+            stub = self._calls.get(method)
+            if stub is None:
+                stub = self._channel.unary_unary(
+                    f"/{self._service}/{method}",
+                    request_serializer=None,
+                    response_deserializer=None,
+                )
+                self._calls[method] = stub
+        if idempotent is None:
+            idempotent = method in IDEMPOTENT_METHODS
         payload = messages.pack(request if request is not None else {})
-        resp = self._calls[method](payload, timeout=timeout)
+        resp = self._policy.call(
+            lambda remaining: stub(payload, timeout=remaining),
+            method=method,
+            timeout=timeout,
+            idempotent=idempotent,
+            breaker=self._breaker,
+        )
         return messages.unpack(resp)
 
     def close(self):

@@ -20,9 +20,11 @@ concurrent RPCs on N sockets, like rpc/ps_client.ShardedPS).
 
 from __future__ import annotations
 
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Tuple
 
+import grpc
 import numpy as np
 
 from elasticdl_tpu.master.kv_shard import (
@@ -47,8 +49,20 @@ class ShardedEmbeddingStore:
         return len(self._clients)
 
     def wait_ready(self, timeout: float = 30.0):
-        for c in self._clients:
-            c.wait_ready(timeout)
+        """One shared deadline across all shards (a serial full-timeout
+        wait per shard would be N×timeout in the worst case): the waits
+        run concurrently, each clipped to the remaining budget."""
+        deadline = time.monotonic() + timeout
+
+        def wait(c):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise grpc.FutureTimeoutError()
+            c.wait_ready(remaining)
+
+        futs = [self._pool.submit(wait, c) for c in self._clients]
+        for f in futs:
+            f.result()
 
     def _shard_of(self, ids: np.ndarray) -> np.ndarray:
         return ids % self.num_shards

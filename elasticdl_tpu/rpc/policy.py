@@ -1,0 +1,269 @@
+"""Unified retry/backoff/deadline policy for the whole RPC plane.
+
+Every RPC in the system — worker->master, worker->PS shard,
+master->KV shard — used to handle failure its own way (mostly: not at
+all; ps_client hand-rolled one 3-attempt loop). This module is the one
+place failure handling lives:
+
+- `RetryPolicy`: exponential backoff with DETERMINISTIC seeded jitter
+  (a stable hash of (seed, method, attempt) — no shared RNG, no wall
+  clock — so a fixed seed makes every retry schedule reproducible in
+  tests), per-status-code retryability, and an overall deadline budget:
+  the caller's `timeout` bounds the WHOLE call including retries and
+  backoff sleeps, never timeout*attempts.
+- Idempotency awareness: only calls that are safe to re-send are
+  retried. Reads are naturally idempotent; PS/KV writes are idempotent
+  because the shards dedup on `report_key` (ps_shard._is_duplicate) or
+  have SETNX/overwrite semantics; master-plane gradient reports and
+  GetTask are NOT (GetTask assigns — a retried GetTask whose first
+  response was lost would orphan a task in the doing-map), so they fall
+  through to the coarser recovery ladder: task requeue + pod relaunch
+  (see docs/fault_model.md).
+- `CircuitBreaker`: per-endpoint fail-fast after repeated consecutive
+  errors, half-opens after a cool-down to probe with a single call.
+  Keeps a worker from burning its whole deadline budget re-dialing a
+  dead shard on every operation.
+
+Errors raised here subclass grpc.RpcError and expose `.code()`, so
+every existing `getattr(e, "code", lambda: None)()` site keeps working.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, FrozenSet, Optional
+
+import grpc
+
+#: Status codes worth re-sending an idempotent call for. INTERNAL is
+#: deliberately absent: a handler exception is deterministic — retrying
+#: re-raises it N times and hides the real error.
+RETRYABLE_CODES: FrozenSet[grpc.StatusCode] = frozenset(
+    {grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED}
+)
+
+#: Method-level idempotency classification (the request shapes make
+#: these safe to re-send; see module docstring + docs/fault_model.md).
+#: Everything NOT listed gets zero retries — same behavior as before
+#: this module existed.
+IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
+    {
+        # master plane: pure reads + the dedup-guarded task report
+        # (TaskDispatcher.report drops duplicate/stale reports)
+        "GetModel",
+        "GetAux",
+        "GetPSConfig",
+        "GetSampleBatch",
+        "ReportTaskResult",
+        "EmbeddingLookup",
+        # PS shard plane: reads, SETNX init, report_key-deduped pushes,
+        # overwrite-semantics opt restore
+        "PSInit",
+        "PSPull",
+        "PSPushGrad",
+        "PSPushDelta",
+        "PSOptState",
+        "PSOptRestore",
+        # KV shard plane: lookup/len/snapshot are reads; update/restore
+        # are last-write-wins row overwrites (or SETNX) — a resend
+        # rewrites the same rows with the same values
+        "KVLookup",
+        "KVUpdate",
+        "KVSnapshot",
+        "KVRestore",
+        "KVLen",
+    }
+)
+
+
+class PolicyRpcError(grpc.RpcError):
+    """grpc.RpcError with an explicit status code, raisable client-side."""
+
+    def __init__(self, code: grpc.StatusCode, details: str):
+        self._code = code
+        self._details = details
+        super().__init__(f"{code.name}: {details}")
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
+
+
+class DeadlineExhausted(PolicyRpcError):
+    """The per-call deadline budget ran out across attempts."""
+
+
+class CircuitOpenError(PolicyRpcError):
+    """Fail-fast: the endpoint's breaker is open (recent repeated errors)."""
+
+    def __init__(self, endpoint: str):
+        super().__init__(
+            grpc.StatusCode.UNAVAILABLE, f"circuit open for {endpoint}"
+        )
+
+
+def _code_of(e: Exception) -> Optional[grpc.StatusCode]:
+    return getattr(e, "code", lambda: None)()
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Retry schedule shared by every RpcClient.
+
+    `max_attempts` counts total tries (1 = the old no-retry behavior).
+    Backoff before attempt k (k>=1 retries) is
+    ``min(initial_backoff * multiplier**(k-1), max_backoff)`` shrunk by
+    up to `jitter` fraction using a hash of (seed, method, k) — fully
+    deterministic for a fixed seed, different across methods/attempts.
+    """
+
+    max_attempts: int = 4
+    initial_backoff: float = 0.05
+    multiplier: float = 2.0
+    max_backoff: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+    retryable_codes: FrozenSet[grpc.StatusCode] = RETRYABLE_CODES
+    # injectable for tests: virtual clocks make schedules wall-clock-free
+    sleep_fn: Callable[[float], None] = field(default=time.sleep, repr=False)
+    clock: Callable[[], float] = field(default=time.monotonic, repr=False)
+
+    @classmethod
+    def from_env(cls, env=None) -> "RetryPolicy":
+        env = os.environ if env is None else env
+        kw = {}
+        if env.get("EDL_RPC_RETRIES"):
+            kw["max_attempts"] = max(1, int(env["EDL_RPC_RETRIES"]))
+        if env.get("EDL_RPC_BACKOFF"):
+            kw["initial_backoff"] = float(env["EDL_RPC_BACKOFF"])
+        if env.get("EDL_RPC_SEED"):
+            kw["seed"] = int(env["EDL_RPC_SEED"])
+        return cls(**kw)
+
+    def backoff_for(self, method: str, attempt: int) -> float:
+        """Backoff before retry number `attempt` (1-based). Deterministic."""
+        base = min(
+            self.initial_backoff * self.multiplier ** (attempt - 1),
+            self.max_backoff,
+        )
+        h = hashlib.sha256(
+            f"{self.seed}:{method}:{attempt}".encode()
+        ).digest()
+        frac = int.from_bytes(h[:8], "big") / 2**64  # [0, 1)
+        return base * (1.0 - self.jitter * frac)
+
+    def call(
+        self,
+        fn: Callable[[float], object],
+        method: str,
+        timeout: float,
+        idempotent: bool,
+        breaker: Optional["CircuitBreaker"] = None,
+    ):
+        """Run fn(per_attempt_timeout) under the policy.
+
+        `timeout` is the TOTAL budget: each attempt gets the remaining
+        slice, and a retry is only scheduled when its backoff still
+        fits inside the budget — retries can never exceed the caller's
+        deadline."""
+        deadline = self.clock() + timeout
+        attempt = 0
+        while True:
+            remaining = deadline - self.clock()
+            if remaining <= 0:
+                raise DeadlineExhausted(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"{method}: deadline budget spent after {attempt} attempts",
+                )
+            if breaker is not None:
+                breaker.before_call()
+            try:
+                result = fn(remaining)
+            except grpc.RpcError as e:
+                if breaker is not None:
+                    breaker.record_failure()
+                attempt += 1
+                code = _code_of(e)
+                if (
+                    not idempotent
+                    or code not in self.retryable_codes
+                    or attempt >= self.max_attempts
+                ):
+                    raise
+                pause = self.backoff_for(method, attempt)
+                if self.clock() + pause >= deadline:
+                    # no room for the backoff + another try: surface the
+                    # real failure instead of sleeping into the deadline
+                    raise
+                self.sleep_fn(pause)
+                continue
+            if breaker is not None:
+                breaker.record_success()
+            return result
+
+
+class CircuitBreaker:
+    """Per-endpoint breaker: after `failure_threshold` CONSECUTIVE
+    failures the circuit opens and calls fail fast with
+    `CircuitOpenError` (code UNAVAILABLE). After `reset_interval`
+    seconds it half-opens: exactly one probe call is let through;
+    success closes the circuit, failure re-opens it (and re-arms the
+    timer). The clock is injectable so tests never sleep."""
+
+    def __init__(
+        self,
+        endpoint: str = "",
+        failure_threshold: int = 5,
+        reset_interval: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.endpoint = endpoint
+        self._threshold = max(1, failure_threshold)
+        self._reset_interval = reset_interval
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._open = False
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._open
+
+    def before_call(self):
+        with self._lock:
+            if not self._open:
+                return
+            now = self._clock()
+            if (
+                now - self._opened_at >= self._reset_interval
+                and not self._probing
+            ):
+                self._probing = True  # half-open: this call is the probe
+                return
+            raise CircuitOpenError(self.endpoint)
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            self._open = False
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._failures >= self._threshold:
+                if not self._open:
+                    # log-free state flip; the caller sees CircuitOpenError
+                    # with the endpoint name on the next call
+                    self._open = True
+                self._opened_at = self._clock()

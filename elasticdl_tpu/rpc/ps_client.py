@@ -40,13 +40,24 @@ class ShardedPS:
         return len(self.endpoints)
 
     def wait_ready(self, timeout: float = 30.0):
-        self._map(lambda c, i: c.wait_ready(timeout), idempotent=True)
+        """Channel readiness under ONE shared deadline: the waits run
+        concurrently and each is clipped to the remaining budget, so
+        the worst case is `timeout` total — never N×timeout."""
+        deadline = time.monotonic() + timeout
 
-    def _map(self, fn, idempotent: bool = False):
+        def wait(c, i):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise grpc.FutureTimeoutError()
+            c.wait_ready(remaining)
+
+        self._map(wait)
+
+    def _map(self, fn):
         """fn(client, shard_index) on every shard concurrently; returns
         results in shard order, re-raising the first failure.
 
-        Failure model — TORN REPORTS, now bounded to hard shard death.
+        Failure model — TORN REPORTS, bounded to hard shard death.
         Shards apply their slices independently; there is no
         cross-shard transaction, so when one shard's RPC fails for good
         after the others applied theirs, the report is torn: the caller
@@ -54,30 +65,16 @@ class ShardedPS:
         no *work* is lost, but the applied slices' version histories
         run ahead by one report — permanent exactness across slices
         would need 2PC, which this plane deliberately omits
-        (ps_shard.py design note). TRANSIENT blips no longer tear:
-        every op retries UNAVAILABLE up to 2 more times. Reads/init are
-        naturally idempotent; pushes carry a per-report `report_key`
-        the shard dedups on (ps_shard.py `_is_duplicate`), so a resend
-        whose first attempt WAS applied (gRPC can surface UNAVAILABLE
-        after the server processed the request) no-ops instead of
-        double-applying."""
-
-        def with_retry(c, i):
-            for attempt in range(3):
-                try:
-                    return fn(c, i)
-                except grpc.RpcError as e:  # pragma: no cover - timing
-                    code = getattr(e, "code", lambda: None)()
-                    if (
-                        not idempotent
-                        or code is not grpc.StatusCode.UNAVAILABLE
-                        or attempt == 2
-                    ):
-                        raise
-                    time.sleep(0.1 * (attempt + 1))
-
+        (ps_shard.py design note). TRANSIENT blips don't tear: retry
+        now lives in RpcClient.call under the shared RetryPolicy
+        (rpc/policy.py) — every PS method is classified idempotent
+        there, because reads/init are naturally idempotent and pushes
+        carry a per-report `report_key` the shard dedups on
+        (ps_shard.py `_is_duplicate`), so a resend whose first attempt
+        WAS applied (gRPC can surface UNAVAILABLE after the server
+        processed the request) no-ops instead of double-applying."""
         futs = [
-            self._pool.submit(with_retry, c, i)
+            self._pool.submit(fn, c, i)
             for i, c in enumerate(self._clients)
         ]
         return [f.result() for f in futs]
@@ -97,7 +94,7 @@ class ShardedPS:
             )["version"]
 
         # SETNX semantics on the shard make a resend a no-op
-        return self._map(do, idempotent=True)
+        return self._map(do)
 
     def pull(
         self,
@@ -121,7 +118,7 @@ class ShardedPS:
                 req["model_dtype"] = model_dtype
             return c.call("PSPull", req)
 
-        resps = self._map(do, idempotent=True)  # read-only
+        resps = self._map(do)  # read-only
         new_versions = [r["version"] for r in resps]
         if any(v < 0 for v in new_versions):
             return new_versions, None
@@ -177,7 +174,7 @@ class ShardedPS:
                 req["model_dtype"] = model_dtype
             return c.call("PSPushDelta", req)
 
-        resps = self._map(do, idempotent=True)
+        resps = self._map(do)
         merged = {
             i: r["vec"] for i, r in enumerate(resps) if r.get("vec") is not None
         }
@@ -212,7 +209,7 @@ class ShardedPS:
                 req["model_dtype"] = model_dtype
             return c.call("PSPushGrad", req)
 
-        resps = self._map(do, idempotent=True)
+        resps = self._map(do)
         new_versions = [r["version"] for r in resps]
         vec = None
         if return_model and all(r.get("vec") is not None for r in resps):
@@ -223,9 +220,7 @@ class ShardedPS:
         """Per-shard optimizer-state leaves (exact resume)."""
         return [
             r["leaves"]
-            for r in self._map(
-                lambda c, i: c.call("PSOptState", {}), idempotent=True
-            )
+            for r in self._map(lambda c, i: c.call("PSOptState", {}))
         ]
 
     def restore_opt(self, shards: List[Optional[list]]):
@@ -235,10 +230,8 @@ class ShardedPS:
                 f"{self.num_shards} — exact resume needs the same "
                 "--num_ps as the checkpointing job"
             )
-        self._map(
-            lambda c, i: c.call("PSOptRestore", {"leaves": shards[i]}),
-            idempotent=True,  # restore overwrites; a resend is a no-op
-        )
+        # restore overwrites; a resend is a no-op (retry-safe)
+        self._map(lambda c, i: c.call("PSOptRestore", {"leaves": shards[i]}))
 
     def _assemble(self, slices: List[np.ndarray]) -> np.ndarray:
         out = np.empty(self.n_params, dtype=np.asarray(slices[0]).dtype)

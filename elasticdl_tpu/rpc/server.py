@@ -25,10 +25,13 @@ def _wrap(fn: Callable) -> Callable:
         req = messages.unpack(request_bytes) if request_bytes else None
         try:
             resp = fn(req) if req is not None else fn({})
-        except Exception:
+        except Exception as e:
             logger.exception("RPC handler %s failed", fn.__name__)
-            context.abort(grpc.StatusCode.INTERNAL, "handler error")
-            raise
+            # abort() raises — nothing after it runs. Carry a sanitized
+            # one-line summary so the client can tell a shape mismatch
+            # from an uninitialized shard without reading server logs.
+            detail = f"{type(e).__name__}: {e}".replace("\n", " ")[:256]
+            context.abort(grpc.StatusCode.INTERNAL, detail)
         return messages.pack(resp)
 
     return handler
@@ -47,6 +50,7 @@ class RpcServer:
         port: int = 0,
         service_name: str = SERVICE_NAME,
         max_workers: int = 64,
+        fault_plan=None,
     ):
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(
@@ -55,9 +59,16 @@ class RpcServer:
             for name, fn in handlers.items()
         }
         generic = grpc.method_handlers_generic_handler(service_name, method_handlers)
+        # server-side chaos: active when EDL_CHAOS_SPEC is set (shard
+        # subprocesses inherit it) or a plan is passed in explicitly
+        from elasticdl_tpu.rpc import chaos
+
+        plan = fault_plan if fault_plan is not None else chaos.FaultPlan.from_env()
+        interceptors = tuple(plan.server_interceptors()) if plan else ()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=GRPC_OPTIONS,
+            interceptors=interceptors,
         )
         self._server.add_generic_rpc_handlers((generic,))
         self.port = self._server.add_insecure_port(f"[::]:{port}")

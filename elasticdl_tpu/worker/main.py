@@ -9,7 +9,10 @@ Exit codes: 0 = job finished cleanly; 1 = crash;
 EXIT_CODE_JOB_FAILED (2) = job finished but the master reported failed
 (dropped poison) tasks — partial data must not look like success to
 the pod phase / process supervisor, yet it must not be relaunched as a
-crash either.
+crash either; EXIT_CODE_MASTER_UNREACHABLE (3) = the master stayed
+unreachable past the RPC retry budget — the worker degrades gracefully
+(exits instead of hanging) and the WorkerManager relaunches it, by
+which time the master may be back.
 """
 
 from __future__ import annotations
@@ -18,10 +21,28 @@ import sys
 
 from elasticdl_tpu.api.model_spec import get_model_spec
 from elasticdl_tpu.common.args import worker_parser
-from elasticdl_tpu.common.constants import EXIT_CODE_JOB_FAILED
+from elasticdl_tpu.common.constants import (
+    EXIT_CODE_JOB_FAILED,
+    EXIT_CODE_MASTER_UNREACHABLE,
+)
 from elasticdl_tpu.common.log_util import get_logger
 
 logger = get_logger(__name__)
+
+
+def _is_unreachable(e: BaseException) -> bool:
+    """True when an error means 'peer endpoint gone past the retry
+    budget' (the shared RetryPolicy already burned its attempts before
+    this surfaced) rather than a worker-side bug."""
+    import grpc
+
+    if isinstance(e, grpc.FutureTimeoutError):
+        return True
+    code = getattr(e, "code", lambda: None)()
+    return code in (
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+    )
 
 
 def main(argv=None) -> int:
@@ -54,11 +75,23 @@ def main(argv=None) -> int:
     )
 
     client = RpcClient(args.master_addr)
-    client.wait_ready(timeout=60)
-    # shard discovery: always ask the master (argv can go stale across
-    # elastic relaunches; empty lists = classic single-PS / in-master
-    # embedding store)
-    ps_cfg = client.call("GetPSConfig", {})
+    try:
+        client.wait_ready(timeout=60)
+        # shard discovery: always ask the master (argv can go stale
+        # across elastic relaunches; empty lists = classic single-PS /
+        # in-master embedding store)
+        ps_cfg = client.call("GetPSConfig", {})
+    except Exception as e:
+        if _is_unreachable(e):
+            logger.error(
+                "master %s unreachable past the retry budget; exiting %d "
+                "for relaunch: %s",
+                args.master_addr,
+                EXIT_CODE_MASTER_UNREACHABLE,
+                e,
+            )
+            return EXIT_CODE_MASTER_UNREACHABLE
+        raise
     ps_endpoints = ps_cfg.get("endpoints") or None
     kv_endpoints = ps_cfg.get("kv_endpoints") or None
     if ps_endpoints:
@@ -101,8 +134,26 @@ def main(argv=None) -> int:
             logger.info("jax.profiler trace -> %s", trace_dir)
         except Exception:
             logger.exception("profiler start failed; continuing untraced")
+    unreachable = False
     try:
         clean = worker.run()
+    except Exception as e:
+        if _is_unreachable(e):
+            # graceful degradation: the control plane (master or a PS
+            # shard) stayed gone through every retry — exit with the
+            # distinct relaunch-eligible code instead of hanging or
+            # dying as an anonymous crash; the dispatcher requeues the
+            # in-flight task on the exit event
+            logger.error(
+                "RPC peer unreachable past the retry budget; exiting %d "
+                "for relaunch: %s",
+                EXIT_CODE_MASTER_UNREACHABLE,
+                e,
+            )
+            unreachable = True
+            clean = False
+        else:
+            raise
     finally:
         if profiling:
             import jax
@@ -111,8 +162,18 @@ def main(argv=None) -> int:
                 jax.profiler.stop_trace()
             except Exception:
                 logger.exception("profiler stop failed")
-        worker.close()
+        try:
+            worker.close()
+        except Exception:
+            # teardown flushes the final sync over RPC — with the peer
+            # already gone that fails too; it must not demote the
+            # distinct exit code to an anonymous crash
+            if not unreachable:
+                raise
+            logger.exception("teardown failed after unreachable peer")
         client.close()
+    if unreachable:
+        return EXIT_CODE_MASTER_UNREACHABLE
     return 0 if clean else EXIT_CODE_JOB_FAILED
 
 

@@ -1,0 +1,567 @@
+"""Chaos-injection layer tests: the FaultPlan spec/scoping/determinism
+unit tier, interceptor behavior against real RPC endpoints, the
+worker-manager response to EXIT_CODE_MASTER_UNREACHABLE, and the
+chaos e2e — a real ProcessBackend training job under injected latency,
+UNAVAILABLE errors, dropped responses, and a worker crash, asserting
+convergence with EXACT task/gradient accounting against a fault-free
+same-seed run."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from elasticdl_tpu.rpc import chaos
+from elasticdl_tpu.rpc.chaos import (
+    CHAOS_CRASH_EXIT_CODE,
+    ENV_ROLE,
+    ENV_SPEC,
+    ENV_TARGET,
+    FaultPlan,
+    InjectedRpcError,
+    chaos_env_for,
+)
+from elasticdl_tpu.rpc.client import RpcClient
+from elasticdl_tpu.rpc.policy import RetryPolicy
+from elasticdl_tpu.rpc.server import RpcServer
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def fast_policy(**kw):
+    kw.setdefault("initial_backoff", 0.01)
+    kw.setdefault("max_backoff", 0.05)
+    return RetryPolicy(**kw)
+
+
+# -- FaultPlan construction and scoping --------------------------------------
+
+
+def test_from_env_inline_spec(monkeypatch):
+    spec = {"seed": 9, "faults": [{"kind": "latency", "latency_ms": 5}]}
+    monkeypatch.setenv(ENV_SPEC, json.dumps(spec))
+    monkeypatch.setenv(ENV_ROLE, "worker")
+    monkeypatch.setenv(ENV_TARGET, "3")
+    plan = FaultPlan.from_env()
+    assert plan is not None
+    assert (plan.seed, plan.role, plan.target_id) == (9, "worker", "3")
+    assert plan.faults[0].kind == "latency"
+
+
+def test_from_env_file_spec(monkeypatch, tmp_path):
+    path = tmp_path / "spec.json"
+    path.write_text(json.dumps({"faults": [{"kind": "drop"}]}))
+    monkeypatch.setenv(ENV_SPEC, f"@{path}")
+    plan = FaultPlan.from_env()
+    assert plan is not None and plan.faults[0].kind == "drop"
+
+
+def test_from_env_absent_or_malformed_is_off(monkeypatch):
+    monkeypatch.delenv(ENV_SPEC, raising=False)
+    assert FaultPlan.from_env() is None
+    # a malformed spec must never take down a training process
+    monkeypatch.setenv(ENV_SPEC, "{not json")
+    assert FaultPlan.from_env() is None
+    monkeypatch.setenv(ENV_SPEC, "@/nonexistent/spec.json")
+    assert FaultPlan.from_env() is None
+    # unknown kinds are a spec bug -> also chaos-off, not a crash
+    monkeypatch.setenv(
+        ENV_SPEC, json.dumps({"faults": [{"kind": "explode"}]})
+    )
+    assert FaultPlan.from_env() is None
+
+
+def test_role_and_target_scoping():
+    spec = {
+        "faults": [
+            {"kind": "drop", "roles": ["worker"], "targets": ["0"]},
+        ]
+    }
+    hit = FaultPlan.from_spec(spec, role="worker", target_id="0")
+    wrong_target = FaultPlan.from_spec(spec, role="worker", target_id="2")
+    wrong_role = FaultPlan.from_spec(spec, role="ps", target_id="0")
+    assert hit.actions_for("M", "client")
+    assert not wrong_target.actions_for("M", "client")
+    assert not wrong_role.actions_for("M", "client")
+
+
+def test_method_and_side_scoping():
+    spec = {"faults": [{"kind": "drop", "methods": ["PSPull"], "side": "server"}]}
+    plan = FaultPlan.from_spec(spec)
+    assert not plan.actions_for("PSPull", "client")
+    assert not plan.actions_for("PSPushGrad", "server")
+    assert plan.actions_for("PSPull", "server")
+
+
+def test_nth_every_and_max_fires():
+    plan = FaultPlan.from_spec(
+        {
+            "faults": [
+                {"kind": "drop", "nth": 3},
+                {"kind": "latency", "every": 2, "max_fires": 2},
+            ]
+        }
+    )
+    kinds = [
+        tuple(f.kind for f in plan.actions_for("M", "client"))
+        for _ in range(8)
+    ]
+    # nth=3 fires exactly once, on call 3; every=2 fires on calls
+    # 2 and 4 then hits max_fires
+    assert kinds == [
+        (), ("latency",), ("drop",), ("latency",), (), (), (), (),
+    ]
+
+
+def test_probabilistic_firing_is_deterministic():
+    spec = {"seed": 5, "faults": [{"kind": "drop", "prob": 0.4}]}
+    a = FaultPlan.from_spec(spec)
+    b = FaultPlan.from_spec(spec)
+    pat_a = [bool(a.actions_for("M", "client")) for _ in range(60)]
+    pat_b = [bool(b.actions_for("M", "client")) for _ in range(60)]
+    assert pat_a == pat_b, "same spec must fire identically"
+    assert 0 < sum(pat_a) < 60, "prob 0.4 over 60 calls fires some, not all"
+    c = FaultPlan.from_spec({"seed": 6, "faults": [{"kind": "drop", "prob": 0.4}]})
+    pat_c = [bool(c.actions_for("M", "client")) for _ in range(60)]
+    assert pat_a != pat_c, "a different seed must reshuffle the firing"
+
+
+def test_once_file_fires_for_exactly_one_plan(tmp_path):
+    """The cross-process crash latch: two processes (modeled as two
+    plans) race on the same once_file; exactly one fires."""
+    latch = str(tmp_path / "crash.once")
+    spec = {"faults": [{"kind": "error", "nth": 1, "once_file": latch}]}
+    first = FaultPlan.from_spec(spec)
+    second = FaultPlan.from_spec(spec)
+    assert first.actions_for("M", "client")
+    assert not second.actions_for("M", "client")
+    assert os.path.exists(latch)
+
+
+def test_chaos_env_for():
+    assert chaos_env_for("worker", 4) == {ENV_ROLE: "worker", ENV_TARGET: "4"}
+    assert chaos_env_for("ps") == {ENV_ROLE: "ps"}
+
+
+# -- interceptors against real RPC endpoints ---------------------------------
+
+
+def _echo_server(hits, fault_plan=None):
+    def echo(req):
+        hits.append(req.get("x"))
+        return {"x": req.get("x")}
+
+    server = RpcServer({"Echo": echo}, port=0, fault_plan=fault_plan)
+    server.start()
+    return server
+
+
+def test_client_error_injection_retried_to_success():
+    hits = []
+    server = _echo_server(hits)
+    try:
+        plan = FaultPlan.from_spec(
+            {"faults": [{"kind": "error", "methods": ["Echo"], "nth": 1}]}
+        )
+        client = RpcClient(
+            f"localhost:{server.port}", policy=fast_policy(), fault_plan=plan
+        )
+        client.wait_ready(10)
+        # injected UNAVAILABLE happens before the send; the retry lands
+        assert client.call("Echo", {"x": 1}, timeout=10, idempotent=True) == {
+            "x": 1
+        }
+        assert hits == [1], "first attempt must never have reached the server"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_client_error_surfaces_on_non_idempotent():
+    hits = []
+    server = _echo_server(hits)
+    try:
+        plan = FaultPlan.from_spec(
+            {"faults": [{"kind": "error", "methods": ["Echo"], "nth": 1}]}
+        )
+        client = RpcClient(
+            f"localhost:{server.port}", policy=fast_policy(), fault_plan=plan
+        )
+        client.wait_ready(10)
+        with pytest.raises(InjectedRpcError) as ei:
+            client.call("Echo", {"x": 1}, timeout=10, idempotent=False)
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert hits == [], "non-idempotent call must not be retried"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_drop_applies_server_side_then_retry_dedupes():
+    """The nastiest shape: the server APPLIES the call, the client sees
+    UNAVAILABLE. The retry must reach the server again — which is
+    exactly why mutating ops carry report_keys for server-side dedup."""
+    hits = []
+    server = _echo_server(hits)
+    try:
+        plan = FaultPlan.from_spec(
+            {"faults": [{"kind": "drop", "methods": ["Echo"], "nth": 1}]}
+        )
+        client = RpcClient(
+            f"localhost:{server.port}", policy=fast_policy(), fault_plan=plan
+        )
+        client.wait_ready(10)
+        assert client.call("Echo", {"x": 7}, timeout=10, idempotent=True) == {
+            "x": 7
+        }
+        assert hits == [7, 7], "dropped call was applied, then retried"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_server_side_error_injection_retried():
+    hits = []
+    plan = FaultPlan.from_spec(
+        {
+            "faults": [
+                {"kind": "error", "methods": ["Echo"], "side": "server",
+                 "nth": 1, "code": "UNAVAILABLE"}
+            ]
+        }
+    )
+    server = _echo_server(hits, fault_plan=plan)
+    try:
+        client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+        client.wait_ready(10)
+        assert client.call("Echo", {"x": 2}, timeout=10, idempotent=True) == {
+            "x": 2
+        }
+        assert hits == [2], "abort happened before the handler ran"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_latency_injection_delays_the_call():
+    hits = []
+    server = _echo_server(hits)
+    try:
+        plan = FaultPlan.from_spec(
+            {"faults": [{"kind": "latency", "methods": ["Echo"],
+                         "latency_ms": 80, "nth": 1}]}
+        )
+        client = RpcClient(f"localhost:{server.port}", fault_plan=plan)
+        client.wait_ready(10)
+        t0 = time.monotonic()
+        client.call("Echo", {"x": 3}, timeout=10)
+        assert time.monotonic() - t0 >= 0.08
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_crash_fault_kills_the_process_with_chaos_exit_code(tmp_path):
+    """End-to-end crash path in a real subprocess: the child's RpcClient
+    picks the spec up from the environment (the production activation
+    path) and `crash when=after` must exit CHAOS_CRASH_EXIT_CODE with
+    the call APPLIED server-side."""
+    hits = []
+    server = _echo_server(hits)
+    try:
+        import elasticdl_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(elasticdl_tpu.__file__))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root
+        env["JAX_PLATFORMS"] = "cpu"
+        env[ENV_SPEC] = json.dumps(
+            {
+                "faults": [
+                    {"kind": "crash", "methods": ["Echo"], "roles": ["worker"],
+                     "nth": 1, "when": "after"}
+                ]
+            }
+        )
+        env.update(chaos_env_for("worker", 0))
+        child = (
+            "from elasticdl_tpu.rpc.client import RpcClient\n"
+            f"c = RpcClient('localhost:{server.port}')\n"
+            "c.wait_ready(10)\n"
+            "c.call('Echo', {'x': 9}, timeout=10)\n"
+            "print('survived')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", child],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == CHAOS_CRASH_EXIT_CODE, proc.stderr
+        assert "survived" not in proc.stdout
+        assert hits == [9], "crash-after must fire with the call applied"
+    finally:
+        server.stop()
+
+
+# -- worker-manager handling of the unreachable exit code --------------------
+
+
+def test_master_unreachable_exit_is_relaunch_eligible():
+    """A worker that exits EXIT_CODE_MASTER_UNREACHABLE (graceful
+    degradation, not a crash) must get its in-flight tasks requeued and
+    a replacement launched — unlike EXIT_CODE_JOB_FAILED, which is
+    terminal by design."""
+    from elasticdl_tpu.cluster.pod_backend import PodBackend, PodEvent, PodPhase
+    from elasticdl_tpu.common.constants import (
+        EXIT_CODE_JOB_FAILED,
+        EXIT_CODE_MASTER_UNREACHABLE,
+    )
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.master.worker_manager import WorkerManager
+
+    class FakeBackend(PodBackend):
+        def __init__(self):
+            self.started = []
+            self._cb = None
+
+        def set_event_callback(self, cb):
+            self._cb = cb
+
+        def start_worker(self, worker_id, argv, envs):
+            self.started.append(worker_id)
+
+        def delete_worker(self, worker_id):
+            pass
+
+        def stop(self):
+            pass
+
+        def fire(self, worker_id, exit_code):
+            self._cb(PodEvent(worker_id, PodPhase.FAILED, exit_code=exit_code))
+
+    dispatcher = TaskDispatcher({"f": 64}, {}, {}, 16, 1)
+    backend = FakeBackend()
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=2,
+        worker_argv_fn=lambda wid: [],
+        max_relaunches=4,
+    )
+    manager.start_workers()
+    assert dispatcher.get(0) is not None
+    before = dispatcher.pending_count()
+    backend.fire(0, EXIT_CODE_MASTER_UNREACHABLE)
+    assert dispatcher.pending_count() == before + 1, "task not recovered"
+    assert backend.started == [0, 1, 2], "no replacement launched"
+    assert manager.relaunches() == 1
+    # contrast: a worker that exits JOB_FAILED is NOT replaced
+    backend.fire(1, EXIT_CODE_JOB_FAILED)
+    assert backend.started == [0, 1, 2]
+
+
+# -- the chaos e2e -----------------------------------------------------------
+
+
+def _grep_logs(log_dir, needle):
+    count = 0
+    for name in os.listdir(log_dir):
+        with open(os.path.join(log_dir, name), errors="replace") as f:
+            count += f.read().count(needle)
+    return count
+
+
+def _run_training_job(tmp, tag, monkeypatch, chaos_spec):
+    """One ProcessBackend sync-SGD job (2 workers, 2 inproc PS shards,
+    per-step gradient pushes). Returns the accounting the chaos test
+    compares across runs."""
+    from elasticdl_tpu.cluster.pod_backend import ProcessBackend
+    from elasticdl_tpu.common.args import master_parser, worker_forward_args
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.master.worker_manager import WorkerManager
+
+    if chaos_spec is None:
+        monkeypatch.delenv(ENV_SPEC, raising=False)
+    else:
+        monkeypatch.setenv(ENV_SPEC, json.dumps(chaos_spec))
+    args = master_parser().parse_args(
+        [
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--training_data_dir", tmp,
+            "--records_per_task", "32",
+            "--num_epochs", "2",
+            "--grads_to_wait", "1",
+            "--num_workers", "2",
+            "--worker_backend", "process",
+            "--num_ps", "2",
+            "--ps_mode", "inproc",
+            "--staleness_window", "1",
+        ]
+    )
+    _spec, dispatcher, servicer, _evs, _ckpt = build_master(args, "training")
+    server = RpcServer(servicer.handlers(), port=0)
+    server.start()
+    addr = f"localhost:{server.port}"
+    log_dir = os.path.join(tmp, f"logs-{tag}")
+    backend = ProcessBackend(log_dir=log_dir)
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=2,
+        worker_argv_fn=lambda wid: worker_forward_args(args, wid, addr),
+        envs={"JAX_PLATFORMS": "cpu"},
+        max_relaunches=4,
+    )
+    manager.start_workers()
+    try:
+        deadline = time.time() + 300
+        while not dispatcher.finished():
+            assert time.time() < deadline, f"job[{tag}] stuck"
+            assert not manager.all_exited(), f"job[{tag}]: all workers gone"
+            time.sleep(0.05)
+        assert not dispatcher.has_failed_tasks()
+        params, _aux, _version = servicer.get_params_copy()
+        stats = [sv.stats() for sv in servicer.ps_group.servicers]
+        return {
+            "completed_records": dispatcher.completed_records(),
+            "versions": [s["version"] for s in stats],
+            "applied": sum(s["applied_pushes"] for s in stats),
+            "duplicates": sum(s["duplicate_pushes"] for s in stats),
+            "relaunches": manager.relaunches(),
+            "kernel": float(
+                np.asarray(params["Dense_0"]["kernel"]).ravel()[0]
+            ),
+            "log_dir": log_dir,
+        }
+    finally:
+        manager.stop_relaunch_and_remove_workers()
+        backend.stop()
+        server.stop()
+        if servicer.ps_group is not None:
+            servicer.ps_group.stop()
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+def test_chaos_training_job_exact_accounting(tmp_path, monkeypatch):
+    """The acceptance test: inject latency + UNAVAILABLE errors +
+    dropped responses + a worker crash into a real ProcessBackend
+    training run. The job must converge with EXACT accounting — every
+    task completed exactly once, every retried gradient push absorbed
+    by the report_key dedup ring — and finish at the IDENTICAL final
+    shard versions as a fault-free run of the same seed/fixture.
+
+    The fixture: 2 files x 64 records x 2 epochs / minibatch 16 =
+    16 gradient pushes per shard; grads_to_wait=1 applies each push,
+    so the fault-free final version of every shard is exactly 16."""
+    from elasticdl_tpu.testing import write_linear_records
+
+    tmp = str(tmp_path)
+    for i in range(2):
+        write_linear_records(
+            os.path.join(tmp, f"shard-{i}.rio"), 64, seed=i, noise=0.05
+        )
+    chaos_spec = {
+        "seed": 11,
+        "faults": [
+            # slow shard: deterministic added latency on model pulls
+            {"kind": "latency", "methods": ["PSPull"], "roles": ["worker"],
+             "latency_ms": 20, "every": 1, "max_fires": 4},
+            # flaky network: periodic UNAVAILABLE before the send
+            {"kind": "error", "code": "UNAVAILABLE",
+             "methods": ["PSPushGrad"], "roles": ["worker"], "every": 4,
+             "max_fires": 3},
+            # lost response: the push APPLIES, the worker must retry and
+            # the shard's dedup ring must absorb the resend
+            {"kind": "drop", "methods": ["PSPushGrad"], "roles": ["worker"],
+             "nth": 3},
+            # process death mid-job: worker 0 dies right after being
+            # ASSIGNED its second task (never processed); recover_tasks
+            # must requeue it and a replacement must finish the job.
+            # targets+once_file keep the replacement from dying too.
+            {"kind": "crash", "methods": ["GetTask"], "roles": ["worker"],
+             "targets": ["0"], "nth": 2, "when": "after",
+             "once_file": os.path.join(tmp, "crash.once")},
+        ],
+    }
+    under_chaos = _run_training_job(tmp, "chaos", monkeypatch, chaos_spec)
+    fault_free = _run_training_job(tmp, "clean", monkeypatch, None)
+
+    # every record processed exactly once, in both runs
+    assert under_chaos["completed_records"] == 256
+    assert fault_free["completed_records"] == 256
+    # the crash actually happened and was recovered by a relaunch
+    assert under_chaos["relaunches"] >= 1
+    assert os.path.exists(os.path.join(tmp, "crash.once"))
+    # the dropped-response retries were absorbed, not double-applied:
+    # final shard versions are IDENTICAL to the fault-free run
+    assert under_chaos["versions"] == fault_free["versions"] == [16, 16]
+    assert under_chaos["duplicates"] >= 1, "no drop-retry was deduped"
+    assert under_chaos["applied"] == fault_free["applied"] == 32
+    # all four fault kinds demonstrably fired inside the workers
+    assert _grep_logs(under_chaos["log_dir"], "chaos: +20ms latency") >= 1
+    assert _grep_logs(under_chaos["log_dir"], "chaos: injecting UNAVAILABLE") >= 1
+    assert _grep_logs(under_chaos["log_dir"], "chaos: dropping response") >= 1
+    assert _grep_logs(under_chaos["log_dir"], "chaos: crashing process") == 1
+    # the fault-free run saw no chaos at all
+    assert _grep_logs(fault_free["log_dir"], "chaos:") == 0
+    # and the model still converged (y = 2x + 1 fixture)
+    assert abs(under_chaos["kernel"] - 2.0) < 0.6, under_chaos["kernel"]
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_stress_high_fault_rate(tmp_path, monkeypatch):
+    """Long stress variant (excluded from the default tier via the
+    `slow` marker): much higher fault pressure — probabilistic errors
+    and latency on the whole PS plane, periodic drops, and BOTH initial
+    workers crashing — must still produce exact accounting. Both
+    crashes land on GetTask (between assignment and processing): a
+    crash in the window between pushing a step's gradients and
+    reporting the task would requeue an already-pushed task, and its
+    re-run pushes again under fresh report_keys — the per-step path
+    deliberately trades that re-train for liveness, so only
+    assignment-window crashes keep the 16-push version invariant."""
+    from elasticdl_tpu.testing import write_linear_records
+
+    tmp = str(tmp_path)
+    for i in range(2):
+        write_linear_records(
+            os.path.join(tmp, f"shard-{i}.rio"), 64, seed=i, noise=0.05
+        )
+    chaos_spec = {
+        "seed": 23,
+        "faults": [
+            {"kind": "latency", "methods": ["PSPull", "PSPushGrad"],
+             "roles": ["worker"], "prob": 0.3, "latency_ms": 15},
+            {"kind": "error", "code": "UNAVAILABLE",
+             "methods": ["PSPull", "PSPushGrad"], "roles": ["worker"],
+             "prob": 0.15},
+            {"kind": "error", "code": "DEADLINE_EXCEEDED",
+             "methods": ["PSPull"], "roles": ["worker"], "nth": 1},
+            {"kind": "drop", "methods": ["PSPushGrad"], "roles": ["worker"],
+             "every": 7},
+            {"kind": "crash", "methods": ["GetTask"], "roles": ["worker"],
+             "targets": ["0"], "nth": 2, "when": "after",
+             "once_file": os.path.join(tmp, "crash-0.once")},
+            {"kind": "crash", "methods": ["GetTask"], "roles": ["worker"],
+             "targets": ["1"], "nth": 3, "when": "before",
+             "once_file": os.path.join(tmp, "crash-1.once")},
+        ],
+    }
+    out = _run_training_job(tmp, "stress", monkeypatch, chaos_spec)
+    assert out["completed_records"] == 256
+    assert out["relaunches"] >= 2, "both crash faults must have fired"
+    assert out["versions"] == [16, 16]
+    assert out["applied"] == 32
+    assert out["duplicates"] >= 1

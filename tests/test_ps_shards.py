@@ -396,17 +396,31 @@ def test_sharded_eval_service_pins_and_completes(tmp_path):
 
 def test_transient_shard_failure_push_retries_untorn():
     """VERDICT r4 #9: a shard endpoint blipping mid-push (UNAVAILABLE)
-    must not tear the report. Two transient shapes: (a) the request
-    never reached the shard — the retry applies it; (b) the shard
-    APPLIED it but the connection died before the response — the retry
-    hits the shard's report_key dedup and must NOT double-apply."""
-    import grpc
-
+    must not tear the report. Two transient shapes, now injected at the
+    gRPC interceptor layer (rpc/chaos.py) so the REAL retry path —
+    RpcClient.call under the shared RetryPolicy — is what recovers:
+    (a) `error`: the request never reached the shard — the retry
+    applies it; (b) `drop`: the shard APPLIED it but the response was
+    lost — the retry hits the shard's report_key dedup and must NOT
+    double-apply."""
+    from elasticdl_tpu.rpc.chaos import FaultPlan
+    from elasticdl_tpu.rpc.client import RpcClient
+    from elasticdl_tpu.rpc.policy import RetryPolicy
     from elasticdl_tpu.rpc.ps_client import ShardedPS
 
-    class Unavailable(grpc.RpcError):
-        def code(self):
-            return grpc.StatusCode.UNAVAILABLE
+    fast = RetryPolicy(initial_backoff=0.01, max_backoff=0.05)
+
+    def blip_shard_1(ps, group, kind):
+        """Swap shard 1's client for one whose first PSPushDelta blips."""
+        ps._clients[1].close()
+        ps._clients[1] = RpcClient(
+            group.endpoints[1],
+            policy=fast,
+            fault_plan=FaultPlan.from_spec(
+                {"faults": [{"kind": kind, "methods": ["PSPushDelta"],
+                             "nth": 1}]}
+            ),
+        )
 
     group = PSShardGroup(3, mode="inproc")
     group.start()
@@ -415,36 +429,25 @@ def test_transient_shard_failure_push_retries_untorn():
         group.ensure_init(vec0, version=0)
         ps = ShardedPS(group.endpoints, 10)
 
-        # (a) lost request: fail shard 1's first PSPushDelta pre-call
-        victim = ps._clients[1]
-        orig_call = victim.call
-        state = {"mode": "lost", "fails": 1}
-
-        def flaky_call(method, req):
-            if method == "PSPushDelta" and state["fails"] > 0:
-                state["fails"] -= 1
-                if state["mode"] == "lost":
-                    raise Unavailable()
-                orig_call(method, req)  # shard applies...
-                raise Unavailable()  # ...but the response is lost
-            return orig_call(method, req)
-
-        victim.call = flaky_call
+        # (a) lost request: shard 1's first PSPushDelta errors pre-send
+        blip_shard_1(ps, group, "error")
         versions, _ = ps.push_delta(
             np.ones(10, np.float32), steps=2, base_versions=[0, 0, 0]
         )
         assert versions == [2, 2, 2], f"torn after lost request: {versions}"
         _, vec = ps.pull()
         np.testing.assert_allclose(vec, 1.0)
+        assert group.servicers[1].stats()["duplicate_pushes"] == 0
 
         # (b) applied-but-response-lost: the dedup must absorb the retry
-        state.update(mode="applied", fails=1)
+        blip_shard_1(ps, group, "drop")
         versions, _ = ps.push_delta(
             np.ones(10, np.float32), steps=2, base_versions=[2, 2, 2]
         )
         assert versions == [4, 4, 4], f"torn after response loss: {versions}"
         _, vec = ps.pull()
         np.testing.assert_allclose(vec, 2.0)  # applied exactly once
+        assert group.servicers[1].stats()["duplicate_pushes"] == 1
         ps.close()
     finally:
         group.stop()

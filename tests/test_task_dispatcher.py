@@ -121,3 +121,31 @@ def test_report_without_worker_id_still_accepted():
     t = d.get(worker_id=0)
     assert d.report(t.task_id, True) is True
     assert d.finished()
+
+
+def test_retry_exhaustion_drops_poison_task_for_good():
+    """A task that fails max_task_retries times is dropped into
+    failed_tasks — counted, flagged via has_failed_tasks(), and NOT
+    requeued — and a later recover_tasks() for its last worker must
+    not resurrect it (the poison drop is a terminal verdict, not an
+    in-flight assignment)."""
+    d = TaskDispatcher({"f1": 10}, {}, {}, 10, 1, max_task_retries=2)
+    # failure 1: requeued (retry budget not yet exhausted)
+    t = d.get(worker_id=0)
+    assert d.report(t.task_id, False, worker_id=0) is True
+    assert d.pending_count() == 1
+    assert not d.has_failed_tasks()
+    # failure 2: budget exhausted -> dropped, not requeued
+    t = d.get(worker_id=0)
+    assert d.report(t.task_id, False, worker_id=0) is True
+    assert d.pending_count() == 0
+    assert d.has_failed_tasks()
+    assert [ft.task_id for ft in d.failed_tasks] == [t.task_id]
+    # the job ENDS (finished True) but is reported failed by the caller
+    assert d.finished()
+    assert d.completed_records() == 0
+    # the worker that last held the poison task dies: recovery must not
+    # bring the dropped task back from the dead
+    d.recover_tasks(0)
+    assert d.pending_count() == 0
+    assert d.finished() and d.has_failed_tasks()
