@@ -1,0 +1,25 @@
+"""edl-lint: AST-based static analysis for the elastic-training
+invariants that only fail probabilistically at runtime.
+
+Run as ``python -m elasticdl_tpu.analysis`` (see __main__.py) or from
+tests via :func:`run_analysis`. Rule catalog, suppression syntax, and
+the baseline workflow are documented in docs/static_analysis.md.
+"""
+
+from elasticdl_tpu.analysis.core import (
+    RULE_FAMILIES,
+    Finding,
+    apply_baseline,
+    load_baseline,
+    run_analysis,
+    save_baseline,
+)
+
+__all__ = [
+    "RULE_FAMILIES",
+    "Finding",
+    "apply_baseline",
+    "load_baseline",
+    "run_analysis",
+    "save_baseline",
+]

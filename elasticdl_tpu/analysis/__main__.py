@@ -1,0 +1,118 @@
+"""CLI: python -m elasticdl_tpu.analysis [--rule ...] [--format text|json]
+
+Exit codes: 0 — no findings beyond the baseline; 1 — new findings (or
+stale baseline entries under --strict-baseline); 2 — usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from elasticdl_tpu.analysis.core import (
+    RULE_FAMILIES,
+    apply_baseline,
+    load_baseline,
+    run_analysis,
+    save_baseline,
+)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.analysis",
+        description="edl-lint: static analysis for the RPC/lock/jit/env "
+        "invariants (docs/static_analysis.md)",
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        choices=list(RULE_FAMILIES),
+        help="run only this rule family (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="finding output format (default: text)",
+    )
+    parser.add_argument(
+        "--root", default=_PKG_ROOT,
+        help="directory tree to analyze (default: the elasticdl_tpu package)",
+    )
+    parser.add_argument(
+        "--baseline", default=_DEFAULT_BASELINE,
+        help="accepted-findings file (default: analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept the current findings: rewrite the baseline and exit 0",
+    )
+    parser.add_argument(
+        "--strict-baseline", action="store_true",
+        help="also fail on stale baseline entries (fixed findings that "
+        "should be removed from the baseline)",
+    )
+    args = parser.parse_args(argv)
+
+    if not os.path.isdir(args.root):
+        print(f"error: --root {args.root} is not a directory", file=sys.stderr)
+        return 2
+
+    findings = run_analysis(args.root, rules=args.rule)
+
+    if args.write_baseline:
+        save_baseline(args.baseline, findings)
+        print(
+            f"wrote {len(findings)} accepted finding(s) to {args.baseline}"
+        )
+        return 0
+
+    baseline = {}
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = load_baseline(args.baseline)
+    new, stale = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "new": [f.to_dict() for f in new],
+                    "baselined": len(findings) - len(new),
+                    "stale_baseline_keys": stale,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        if stale and (args.strict_baseline or not new):
+            for key in stale:
+                print(f"stale baseline entry (finding no longer occurs): {key}")
+        n_base = len(findings) - len(new)
+        summary = f"{len(new)} finding(s)"
+        if n_base:
+            summary += f", {n_base} baselined"
+        if stale:
+            summary += f", {len(stale)} stale baseline entr(y/ies)"
+        print(summary)
+
+    if new:
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,358 @@
+"""edl-lint core: file loading, suppressions, baselines, rule registry.
+
+The framework's correctness rests on invariants that only show up at
+runtime — and then only probabilistically, under chaos (rpc/chaos.py):
+every string-keyed RPC must resolve to a registered handler with the
+right idempotency classification, every mutation of lock-owning shared
+state must happen under its lock, every jit-traced function must stay
+pure, and every EDL_*/K8S_* env var must be a declared operator knob.
+This package proves those invariants *statically*, on every commit,
+from the AST alone (nothing here imports the code under analysis, so
+the lint runs without jax/grpc and can lint broken trees).
+
+Rule families (one module each):
+
+- ``rpc-conformance``  (rpc_conformance.py)
+- ``lock-discipline``  (lock_discipline.py)
+- ``jit-purity``       (jit_purity.py)
+- ``env-registry``     (env_registry.py)
+
+Findings support inline suppression with a mandatory reason::
+
+    x = self._version  # edl-lint: disable=lock-discipline -- <why>
+
+On a ``def``/``class``/``with`` line (or on a standalone comment line
+directly above one) the suppression covers the whole block. A
+suppression without a ``-- reason`` is itself a finding.
+
+Pre-existing accepted findings live in ``analysis/baseline.json``
+(multiset of finding keys): baselined findings don't fail the run, new
+ones do. Keys deliberately omit line numbers so unrelated edits don't
+invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: the selectable rule families, in report order
+RULE_FAMILIES = (
+    "rpc-conformance",
+    "lock-discipline",
+    "jit-purity",
+    "env-registry",
+)
+
+#: internal families emitted by the core itself (always on, never
+#: suppressible: a broken suppression must not hide itself)
+CORE_FAMILIES = ("lint",)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # family name (RULE_FAMILIES or "lint")
+    check: str  # specific check within the family
+    path: str  # posix path relative to the analysis root
+    line: int  # 1-based; NOT part of the baseline key
+    message: str  # stable, line-number-free
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}|{self.check}|{self.path}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}/{self.check}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*edl-lint:\s*disable=([A-Za-z0-9_,\-]+)(?:\s*--\s*(\S.*))?"
+)
+
+_BLOCK_NODES = (
+    ast.FunctionDef,
+    ast.AsyncFunctionDef,
+    ast.ClassDef,
+    ast.With,
+    ast.AsyncWith,
+)
+
+
+class _Suppressions:
+    """Per-file suppression ranges: rule family -> [(start, end)]."""
+
+    def __init__(self) -> None:
+        self.ranges: Dict[str, List[Tuple[int, int]]] = {}
+
+    def add(self, rule: str, start: int, end: int) -> None:
+        self.ranges.setdefault(rule, []).append((start, end))
+
+    def covers(self, rule: str, line: int) -> bool:
+        for start, end in self.ranges.get(rule, ()):
+            if start <= line <= end:
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: str  # relative posix path
+    source: str
+    tree: Optional[ast.AST]  # None when the file failed to parse
+    suppressions: _Suppressions
+    #: findings produced while loading (parse errors, bad suppressions)
+    load_findings: List[Finding]
+
+
+def _block_range(tree: ast.AST, line: int) -> Tuple[int, int]:
+    """The lines a suppression at `line` covers: the whole block when
+    `line` starts (or a standalone comment directly precedes) a
+    def/class/with, else just that line."""
+    starts: Dict[int, Tuple[int, int]] = {}
+    stmt_lines: List[Tuple[int, ast.stmt]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, _BLOCK_NODES):
+            starts[node.lineno] = (node.lineno, node.end_lineno or node.lineno)
+        if isinstance(node, ast.stmt):
+            stmt_lines.append((node.lineno, node))
+    if line in starts:
+        return starts[line]
+    # standalone comment: attach to the next statement down
+    nxt = None
+    for ln, node in stmt_lines:
+        if ln > line and (nxt is None or ln < nxt[0]):
+            nxt = (ln, node)
+    if nxt is not None and nxt[0] in starts:
+        return starts[nxt[0]]
+    if nxt is not None:
+        return (nxt[0], nxt[0])
+    return (line, line)
+
+
+def _parse_suppressions(
+    path: str, source: str, tree: Optional[ast.AST]
+) -> Tuple[_Suppressions, List[Finding]]:
+    sup = _Suppressions()
+    findings: List[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sup, findings
+    known = set(RULE_FAMILIES)
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        line = tok.start[0]
+        rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            findings.append(
+                Finding(
+                    rule="lint",
+                    check="suppression-missing-reason",
+                    path=path,
+                    line=line,
+                    message=(
+                        "edl-lint suppression must carry a reason: "
+                        "`# edl-lint: disable=<rule> -- <why>`"
+                    ),
+                )
+            )
+            continue
+        bad = [r for r in rules if r not in known]
+        if bad:
+            findings.append(
+                Finding(
+                    rule="lint",
+                    check="unknown-suppressed-rule",
+                    path=path,
+                    line=line,
+                    message=(
+                        f"suppression names unknown rule(s) {sorted(bad)}; "
+                        f"known: {sorted(known)}"
+                    ),
+                )
+            )
+        standalone = source.splitlines()[line - 1].lstrip().startswith("#")
+        if tree is not None:
+            if standalone:
+                start, end = _block_range(tree, line)
+            else:
+                start, end = _block_range(tree, line)
+                # inline comment on a non-block line: cover that line only
+                if start != line:
+                    start = end = line
+        else:
+            start = end = line
+        for r in rules:
+            if r in known:
+                sup.add(r, start, end)
+    return sup, findings
+
+
+class AnalysisContext:
+    """Everything a rule needs: the parsed file set, rooted at `root`."""
+
+    def __init__(self, root: str, files: Dict[str, SourceFile]):
+        self.root = root
+        self.files = files
+
+    def trees(self):
+        for path, f in sorted(self.files.items()):
+            if f.tree is not None:
+                yield path, f.tree
+
+
+def load_context(root: str) -> AnalysisContext:
+    files: Dict[str, SourceFile] = {}
+    root = os.path.abspath(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        ]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            abspath = os.path.join(dirpath, fn)
+            rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+            try:
+                with open(abspath, encoding="utf-8") as f:
+                    source = f.read()
+            except (OSError, UnicodeDecodeError) as e:
+                files[rel] = SourceFile(
+                    rel,
+                    "",
+                    None,
+                    _Suppressions(),
+                    [
+                        Finding(
+                            "lint", "unreadable-file", rel, 1,
+                            f"cannot read file: {type(e).__name__}",
+                        )
+                    ],
+                )
+                continue
+            load_findings: List[Finding] = []
+            try:
+                tree = ast.parse(source)
+            except SyntaxError as e:
+                tree = None
+                load_findings.append(
+                    Finding(
+                        "lint", "parse-error", rel, e.lineno or 1,
+                        f"syntax error: {e.msg}",
+                    )
+                )
+            sup, sup_findings = _parse_suppressions(rel, source, tree)
+            load_findings.extend(sup_findings)
+            files[rel] = SourceFile(rel, source, tree, sup, load_findings)
+    return AnalysisContext(root, files)
+
+
+def _rule_runners():
+    # local import: the rule modules import core for Finding
+    from elasticdl_tpu.analysis import (
+        env_registry,
+        jit_purity,
+        lock_discipline,
+        rpc_conformance,
+    )
+
+    return {
+        "rpc-conformance": rpc_conformance.run,
+        "lock-discipline": lock_discipline.run,
+        "jit-purity": jit_purity.run,
+        "env-registry": env_registry.run,
+    }
+
+
+def run_analysis(
+    root: str, rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run the selected rule families over `root`; returns the
+    UNSUPPRESSED findings (suppression comments already applied),
+    sorted by (path, line, rule)."""
+    ctx = load_context(root)
+    selected = list(rules) if rules else list(RULE_FAMILIES)
+    unknown = [r for r in selected if r not in RULE_FAMILIES]
+    if unknown:
+        raise ValueError(f"unknown rule(s) {unknown}; known: {RULE_FAMILIES}")
+    findings: List[Finding] = []
+    for f in ctx.files.values():
+        findings.extend(f.load_findings)
+    runners = _rule_runners()
+    for name in selected:
+        findings.extend(runners[name](ctx))
+    kept = []
+    for fi in findings:
+        sf = ctx.files.get(fi.path)
+        if (
+            sf is not None
+            and fi.rule in RULE_FAMILIES
+            and sf.suppressions.covers(fi.rule, fi.line)
+        ):
+            continue
+        kept.append(fi)
+    kept.sort(key=lambda fi: (fi.path, fi.line, fi.rule, fi.check, fi.message))
+    return kept
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """baseline.json -> {finding key: accepted count}."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts: Dict[str, int] = {}
+    for key in data.get("findings", []):
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    keys = sorted(fi.key for fi in findings)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "comment": (
+                    "Accepted pre-existing edl-lint findings. Regenerate "
+                    "with `python -m elasticdl_tpu.analysis "
+                    "--write-baseline` after REVIEWING every new entry; "
+                    "new findings not listed here fail the run."
+                ),
+                "findings": keys,
+            },
+            f,
+            indent=2,
+        )
+        f.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], List[str]]:
+    """-> (new findings not covered by the baseline, stale baseline
+    keys that no longer occur). Duplicate keys are matched as a
+    multiset: the first `baseline[key]` occurrences are accepted."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    for fi in findings:
+        if remaining.get(fi.key, 0) > 0:
+            remaining[fi.key] -= 1
+        else:
+            new.append(fi)
+    stale = sorted(k for k, n in remaining.items() if n > 0)
+    return new, stale

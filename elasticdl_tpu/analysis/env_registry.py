@@ -1,0 +1,158 @@
+"""env-registry: every EDL_*/K8S_* env var must be a declared knob.
+
+Operator-facing environment variables are this framework's config
+surface; an undeclared one is an undocumented, untypo-checked knob.
+``common/constants.py`` holds the registry::
+
+    ENV_RPC_RETRIES = "EDL_RPC_RETRIES"
+    ENV_REGISTRY = {ENV_RPC_RETRIES: "total RPC attempts...", ...}
+
+The rule finds every read/write keyed by an ``EDL_``/``K8S_``-prefixed
+string — ``os.environ.get(K)``, ``os.getenv(K)``, ``env[K]``,
+``env.get(K)`` — whether K is a literal or a name resolving to one
+(same-file assignment or the registry module's constants), and flags:
+
+- ``undeclared-env-var``: the variable is not an ENV_REGISTRY key;
+- ``no-registry``: no ENV_REGISTRY dict exists in the tree at all
+  (emitted once, against the first env read found).
+
+Literal keys are allowed but the constants are preferred; the point of
+the rule is that the registry stays complete, not how it's referenced.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+
+RULE = "env-registry"
+
+_PREFIX = re.compile(r"^(EDL_|K8S_)")
+_REGISTRY_NAME = "ENV_REGISTRY"
+
+
+def _module_str_consts(tree: ast.AST) -> Dict[str, str]:
+    """Module-level NAME = "literal" assignments."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        targets = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if not (isinstance(value, ast.Constant) and isinstance(value.value, str)):
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = value.value
+    return out
+
+
+def _find_registry(
+    ctx: AnalysisContext,
+) -> Tuple[Optional[str], Set[str], Dict[str, str]]:
+    """(registry path, declared var names, global const map)."""
+    for path, tree in ctx.trees():
+        consts = _module_str_consts(tree)
+        for node in ast.walk(tree):
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                if isinstance(node.targets[0], ast.Name):
+                    target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    target, value = node.target.id, node.value
+            if target != _REGISTRY_NAME or not isinstance(value, ast.Dict):
+                continue
+            declared: Set[str] = set()
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    declared.add(k.value)
+                elif isinstance(k, ast.Name) and k.id in consts:
+                    declared.add(consts[k.id])
+            return path, declared, consts
+    return None, set(), {}
+
+
+def _resolve_key(
+    node: ast.expr, local_consts: Dict[str, str], global_consts: Dict[str, str]
+) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return local_consts.get(node.id) or global_consts.get(node.id)
+    return None
+
+
+def _env_key_uses(
+    tree: ast.AST, local_consts, global_consts
+) -> List[Tuple[str, int]]:
+    """(var name, line) for every env-style keyed access whose key
+    resolves to an EDL_/K8S_ string."""
+    uses: List[Tuple[str, int]] = []
+
+    def key_of(node) -> Optional[str]:
+        k = _resolve_key(node, local_consts, global_consts)
+        if k is not None and _PREFIX.match(k):
+            return k
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            # os.getenv(K) / getenv(K)
+            if (
+                (isinstance(f, ast.Attribute) and f.attr == "getenv")
+                or (isinstance(f, ast.Name) and f.id == "getenv")
+            ) and node.args:
+                k = key_of(node.args[0])
+                if k:
+                    uses.append((k, node.lineno))
+            # X.get(K, ...) — mapping lookups; non-env receivers can
+            # only match if they use an EDL_/K8S_ string as a dict key,
+            # which IS an env-var use in this codebase (env dicts)
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("get", "pop", "setdefault")
+                and node.args
+            ):
+                k = key_of(node.args[0])
+                if k:
+                    uses.append((k, node.lineno))
+        # X[K] loads and stores
+        if isinstance(node, ast.Subscript):
+            k = key_of(node.slice)
+            if k:
+                uses.append((k, node.lineno))
+    return uses
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    reg_path, declared, global_consts = _find_registry(ctx)
+    for path, tree in ctx.trees():
+        local_consts = _module_str_consts(tree)
+        for var, line in _env_key_uses(tree, local_consts, global_consts):
+            if reg_path is None:
+                findings.append(
+                    Finding(
+                        RULE, "no-registry", path, line,
+                        f"env var '{var}' used but no ENV_REGISTRY dict "
+                        f"exists to declare it",
+                    )
+                )
+                return findings  # one finding is enough: fix the registry
+            if var not in declared:
+                findings.append(
+                    Finding(
+                        RULE, "undeclared-env-var", path, line,
+                        f"env var '{var}' is read but not declared in "
+                        f"ENV_REGISTRY ({reg_path})",
+                    )
+                )
+    return findings

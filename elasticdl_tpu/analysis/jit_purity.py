@@ -1,0 +1,307 @@
+"""jit-purity: side effects inside jax.jit/pjit-traced functions.
+
+A jitted function runs at TRACE time exactly once per shape signature;
+side effects silently freeze into the compiled program (an env read
+becomes a constant, RNG draws replay the traced value, prints fire once
+then never again). The rule finds every function that reaches
+``jax.jit``/``pjit`` — by decorator (``@jax.jit``,
+``@functools.partial(jax.jit, ...)``), by call (``jax.jit(fn)`` where
+``fn`` resolves to a same-file ``def`` or a lambda), or by assignment —
+and flags inside it (including its nested helper defs):
+
+- ``impure-call``: ``os.environ``/``os.getenv`` reads, ``time.*``,
+  ``random.*`` / ``np.random.*`` (the stateful global RNGs —
+  ``jax.random`` is explicit-key and fine), ``print``, and
+  ``logger``/``logging`` calls;
+- ``captured-mutation``: ``global``/``nonlocal`` declarations and
+  in-place mutation of names captured from the enclosing scope
+  (subscript stores and discarded-result mutator calls rooted at a
+  non-local name) — under trace these mutate tracer state once, not
+  per step. Mutator calls whose result is consumed are NOT flagged:
+  ``updates, state = tx.update(grads, state)`` is optax's pure
+  functional update, while a true ``dict.update``/``list.append``
+  returns None and always appears as a bare statement.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+
+RULE = "jit-purity"
+
+_JIT_NAMES = {"jit", "pjit"}
+_MUTATORS = {
+    "append", "extend", "insert", "add", "discard", "remove", "clear",
+    "update", "setdefault", "pop", "popitem",
+}
+
+_FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def _is_jit_ref(node: ast.expr) -> bool:
+    """jax.jit / jit / pjit / jax.experimental.pjit.pjit as a reference."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def _jit_call_target(node: ast.Call) -> Optional[ast.expr]:
+    """For jax.jit(fn, ...) / partial(jax.jit, ...) return the traced
+    function expression (fn), else None."""
+    if _is_jit_ref(node.func) and node.args:
+        return node.args[0]
+    # functools.partial(jax.jit, static_argnums=...) used as decorator
+    f = node.func
+    is_partial = (isinstance(f, ast.Name) and f.id == "partial") or (
+        isinstance(f, ast.Attribute) and f.attr == "partial"
+    )
+    if is_partial and node.args and _is_jit_ref(node.args[0]):
+        return None  # decorator form: the decorated def is the target
+    return None
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    if _is_jit_ref(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_ref(dec.func):
+            return True
+        f = dec.func
+        is_partial = (isinstance(f, ast.Name) and f.id == "partial") or (
+            isinstance(f, ast.Attribute) and f.attr == "partial"
+        )
+        if is_partial and dec.args and _is_jit_ref(dec.args[0]):
+            return True
+    return False
+
+
+def _collect_targets(tree: ast.AST) -> List[_FuncNode]:
+    """Every function in this module that reaches jit."""
+    defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    targets: List[_FuncNode] = []
+    seen: Set[int] = set()
+
+    def add(fn: Optional[_FuncNode]):
+        if fn is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            targets.append(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                add(node)
+        if isinstance(node, ast.Call):
+            arg = _jit_call_target(node)
+            if arg is None:
+                continue
+            if isinstance(arg, ast.Lambda):
+                add(arg)
+            elif isinstance(arg, ast.Name):
+                for fn in defs_by_name.get(arg.id, []):
+                    add(fn)
+    return targets
+
+
+def _local_names(fn: _FuncNode) -> Set[str]:
+    """Parameter + locally-bound names of fn (its own scope only)."""
+    names: Set[str] = set()
+    a = fn.args
+    for arg in (
+        list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+    ):
+        names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+    def bind_target(t):
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                bind_target(el)
+        elif isinstance(t, ast.Starred):
+            bind_target(t.value)
+
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+            continue  # inner scope binds its own names
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bind_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            bind_target(node.target)
+        elif isinstance(node, ast.For):
+            bind_target(node.target)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    bind_target(item.optional_vars)
+        elif isinstance(node, (ast.comprehension,)):
+            bind_target(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            bind_target(node.target)
+        stack.extend(ast.iter_child_nodes(node))
+    return names
+
+
+def _impure_call_name(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        if f.id == "print":
+            return "print"
+        if f.id == "getenv":
+            return "getenv"
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    root = f.value
+    if isinstance(root, ast.Name):
+        base = root.id
+        if base == "os" and f.attr in ("getenv", "putenv"):
+            return f"os.{f.attr}"
+        if base == "time":
+            return f"time.{f.attr}"
+        if base == "random":
+            return f"random.{f.attr}"
+        if base in ("logger", "logging", "log"):
+            return f"{base}.{f.attr}"
+    # np.random.*, numpy.random.*
+    if (
+        isinstance(root, ast.Attribute)
+        and root.attr == "random"
+        and isinstance(root.value, ast.Name)
+        and root.value.id in ("np", "numpy")
+    ):
+        return f"{root.value.id}.random.{f.attr}"
+    return None
+
+
+def _uses_environ(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    )
+
+
+def _scan_target(path: str, fn: _FuncNode, label: str) -> List[Finding]:
+    findings: List[Finding] = []
+    # local-scope map for the whole nested-def tree: a nested helper's
+    # own locals are legal to mutate, its captures are not
+    locals_of: Dict[int, Set[str]] = {id(fn): _local_names(fn)}
+    scope_of: Dict[int, List[int]] = {}  # node id -> enclosing fn-id chain
+
+    def walk(node: ast.AST, chain: List[int]):
+        scope_of[id(node)] = chain
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            if id(node) not in locals_of:
+                locals_of[id(node)] = _local_names(node)
+                chain = chain + [id(node)]
+        for child in ast.iter_child_nodes(node):
+            walk(child, chain)
+
+    walk(fn, [id(fn)])
+
+    def is_local(name: str, node: ast.AST) -> bool:
+        for fid in reversed(scope_of.get(id(node), [id(fn)])):
+            if name in locals_of.get(fid, ()):  # any enclosing traced scope
+                return True
+        return False
+
+    seen_msgs: Set[Tuple[str, str]] = set()
+    # calls used as bare statements (result discarded): only these can
+    # be in-place mutators — optax-style pure .update() is consumed
+    stmt_calls: Set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            stmt_calls.add(id(node.value))
+
+    def add(check: str, line: int, message: str):
+        if (check, message) in seen_msgs:
+            return
+        seen_msgs.add((check, message))
+        findings.append(Finding(RULE, check, path, line, message))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = _impure_call_name(node)
+            if name is not None:
+                add(
+                    "impure-call", node.lineno,
+                    f"jitted function {label} calls {name} — the value "
+                    f"freezes at trace time",
+                )
+        if _uses_environ(node):
+            add(
+                "impure-call", node.lineno,
+                f"jitted function {label} reads os.environ — the value "
+                f"freezes at trace time",
+            )
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            add(
+                "captured-mutation", node.lineno,
+                f"jitted function {label} declares "
+                f"{'global' if isinstance(node, ast.Global) else 'nonlocal'} "
+                f"{', '.join(node.names)} — rebinding outer state under "
+                f"trace runs once, not per step",
+            )
+        # mutation rooted at a captured name
+        root_name = None
+        line = getattr(node, "lineno", 0)
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            root = node.value
+            while isinstance(root, (ast.Subscript, ast.Attribute)):
+                root = root.value
+            if isinstance(root, ast.Name):
+                root_name = root.id
+        elif (
+            isinstance(node, ast.Call)
+            and id(node) in stmt_calls
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+        ):
+            root = node.func.value
+            while isinstance(root, (ast.Subscript, ast.Attribute)):
+                root = root.value
+            if isinstance(root, ast.Name):
+                root_name = root.id
+        if root_name is not None and not is_local(root_name, node):
+            add(
+                "captured-mutation", line,
+                f"jitted function {label} mutates captured '{root_name}' "
+                f"in place — under trace this runs once, not per step",
+            )
+    return findings
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, tree in ctx.trees():
+        for fn in _collect_targets(tree):
+            label = (
+                f"'{fn.name}'"
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                else "<lambda>"
+            )
+            findings.extend(_scan_target(path, fn, label))
+    return findings

@@ -1,0 +1,258 @@
+"""lock-discipline: shared-state access audited against the class lock.
+
+For every class that creates a ``threading.Lock``/``RLock`` attribute
+(``self._lock = threading.Lock()``), the rule infers the GUARDED
+attribute set — attributes mutated somewhere in the class while a lock
+is held — and then flags:
+
+- ``unguarded-access``: any read or write of a guarded attribute in a
+  method that doesn't hold one of its guarding locks at that point.
+  ``__init__`` is exempt (no concurrent access before construction
+  returns). Nested functions/lambdas start with an empty held set —
+  a closure may run on another thread after the lock is released.
+- ``blocking-under-lock``: a blocking call (``time.sleep``, RPC
+  ``.call(...)``, future ``.result()``, ``.join()``, ``.wait*()``)
+  made while holding a lock — it serializes every other handler behind
+  a network/thread wait.
+
+Helpers designed to run with the caller holding the lock are expected
+to carry a def-line suppression naming the contract, e.g.::
+
+    def _apply(self, grad):  # edl-lint: disable=lock-discipline -- caller holds self._lock
+
+Findings are aggregated to one per (class, method, attribute) so a
+method touching one attribute five times reads as one defect.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+
+RULE = "lock-discipline"
+
+#: method names that mutate their receiver in place
+_MUTATORS = {
+    "append", "extend", "insert", "add", "discard", "remove", "clear",
+    "update", "setdefault", "pop", "popitem", "popleft", "appendleft",
+}
+
+#: attribute calls that block the calling thread
+_BLOCKING_ATTRS = {"call", "result", "join", "wait", "wait_ready"}
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in ("Lock", "RLock"):
+        return True
+    if isinstance(f, ast.Name) and f.id in ("Lock", "RLock"):
+        return True
+    return False
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _Access:
+    __slots__ = ("attr", "line", "write", "held")
+
+    def __init__(self, attr: str, line: int, write: bool, held: frozenset):
+        self.attr = attr
+        self.line = line
+        self.write = write
+        self.held = held
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method body: every self-attribute access with the lock set
+    held at that point, plus blocking calls made under a lock."""
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        self.accesses: List[_Access] = []
+        self.blocking: List[Tuple[int, str, str]] = []  # (line, what, lock)
+        self._held: List[str] = []
+
+    # -- lock tracking
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.lock_attrs:
+                acquired.append(attr)
+            else:
+                self.visit(item.context_expr)
+        self._held.extend(acquired)
+        for st in node.body:
+            self.visit(st)
+        for _ in acquired:
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def _enter_closure(self, node):
+        # a closure can run on another thread after the lock is gone
+        saved, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = saved
+
+    def visit_FunctionDef(self, node):
+        self._enter_closure(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._enter_closure(node)
+
+    # -- accesses
+
+    def _record(self, attr: str, line: int, write: bool):
+        if attr in self.lock_attrs:
+            return
+        self.accesses.append(
+            _Access(attr, line, write, frozenset(self._held))
+        )
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None:
+            self._record(
+                attr, node.lineno, isinstance(node.ctx, (ast.Store, ast.Del))
+            )
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        attr = _self_attr(node.target)
+        if attr is not None:
+            self._record(attr, node.lineno, True)
+            self.visit(node.value)
+            return
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # self.attr[...] = v  /  del self.attr[...]  (any chain depth)
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            root = node.value
+            while isinstance(root, ast.Subscript):
+                root = root.value
+            attr = _self_attr(root)
+            if attr is not None:
+                self._record(attr, node.lineno, True)
+                self.visit(node.slice)
+                return
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        # self.attr.append(...) and friends mutate self.attr
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+            attr = _self_attr(node.func.value)
+            if attr is not None:
+                self._record(attr, node.lineno, True)
+        if self._held:
+            what = self._blocking_name(node)
+            if what is not None:
+                self.blocking.append((node.lineno, what, self._held[-1]))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _blocking_name(node: ast.Call) -> Optional[str]:
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "sleep" and isinstance(f.value, ast.Name) and f.value.id == "time":
+            return "time.sleep"
+        if f.attr in _BLOCKING_ATTRS:
+            # .call() counts only in RPC form (string method name):
+            # callable-style .call(fn, ...) dispatchers are not waits
+            if f.attr == "call" and not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                return None
+            return f".{f.attr}()"
+        return None
+
+
+def _scan_class(path: str, cls: ast.ClassDef) -> List[Finding]:
+    methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
+    lock_attrs: Set[str] = set()
+    for m in methods:
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        lock_attrs.add(attr)
+    if not lock_attrs:
+        return []
+
+    scans: Dict[str, _MethodScan] = {}
+    for m in methods:
+        scan = _MethodScan(lock_attrs)
+        for st in m.body:
+            scan.visit(st)
+        scans[m.name] = scan
+
+    # guarded attribute -> the locks it is written under
+    guarded: Dict[str, Set[str]] = {}
+    for name, scan in scans.items():
+        if name == "__init__":
+            continue
+        for acc in scan.accesses:
+            if acc.write and acc.held:
+                guarded.setdefault(acc.attr, set()).update(acc.held)
+
+    findings: List[Finding] = []
+    for m in methods:
+        if m.name == "__init__":
+            continue
+        scan = scans[m.name]
+        flagged: Dict[str, _Access] = {}
+        for acc in scan.accesses:
+            locks = guarded.get(acc.attr)
+            if not locks or acc.held & locks:
+                continue
+            if acc.attr not in flagged or acc.line < flagged[acc.attr].line:
+                flagged[acc.attr] = acc
+        for attr, acc in sorted(flagged.items()):
+            locks = "/".join(sorted(guarded[attr]))
+            kind = "writes" if acc.write else "reads"
+            findings.append(
+                Finding(
+                    RULE, "unguarded-access", path, acc.line,
+                    f"{cls.name}.{m.name} {kind} self.{attr} without "
+                    f"holding self.{locks} (other methods mutate it "
+                    f"under that lock)",
+                )
+            )
+        for line, what, lock in scan.blocking:
+            findings.append(
+                Finding(
+                    RULE, "blocking-under-lock", path, line,
+                    f"{cls.name}.{m.name} makes blocking call {what} "
+                    f"while holding self.{lock}",
+                )
+            )
+    return findings
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, tree in ctx.trees():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_scan_class(path, node))
+    return findings

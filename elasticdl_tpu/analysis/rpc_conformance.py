@@ -1,0 +1,577 @@
+"""rpc-conformance: string-addressed RPCs checked end to end.
+
+The RPC plane is stringly typed on purpose (no protoc step —
+rpc/server.py), which trades compile-time method/field checking for
+this rule. It cross-references, purely from the AST:
+
+- every call site: ``client.call("Method", {...})`` and the executor
+  form ``pool.submit(client.call, "Method", {...})``;
+- every handler registration: ``handlers()`` methods returning a dict
+  literal of ``{"Method": self.fn}``, plus ``RpcServer({...})``;
+- the retry classification: ``IDEMPOTENT_METHODS`` and
+  ``DEDUP_KEYED_METHODS`` frozensets (rpc/policy.py);
+- the declared request contract: ``WIRE_SCHEMAS`` + the request
+  dataclasses (common/messages.py).
+
+Checks:
+
+- ``no-handler``           call to a method nothing registers
+- ``unused-handler``       registered method nothing calls
+- ``idempotent-no-handler``    classified method with no handler
+- ``idempotent-never-called``  classified method with no call site
+- ``retry-unclassified``   explicit ``idempotent=True`` on a method
+                           outside IDEMPOTENT_METHODS (re-send with no
+                           proven dedup/read semantics)
+- ``dedup-not-idempotent`` DEDUP_KEYED_METHODS not a subset of
+                           IDEMPOTENT_METHODS (a dedup key only
+                           matters for re-sendable methods)
+- ``missing-dedup-key``    call to a dedup-keyed method whose request
+                           dict provably lacks ``report_key``
+- ``unknown-request-key``  call-site dict key absent from the method's
+                           wire dataclass
+- ``handler-unknown-key``  handler reads a request key absent from the
+                           wire dataclass (follows the request through
+                           same-class/module helpers)
+- ``schema-no-handler`` / ``handler-no-schema``  WIRE_SCHEMAS and the
+                           registered handler set must match exactly
+
+Request dicts are resolved from dict literals plus same-function
+dataflow (``req = {...}`` followed by ``req["k"] = v`` /
+``req.update({...})``). A request that can't be resolved to literal
+keys is skipped by the key checks, never guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+
+RULE = "rpc-conformance"
+
+#: request-field container types recognized as the wire contract
+_SCHEMA_MAP_NAME = "WIRE_SCHEMAS"
+_POLICY_SETS = ("IDEMPOTENT_METHODS", "DEDUP_KEYED_METHODS")
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _str_set_from(node) -> Optional[Set[str]]:
+    """frozenset({...}) / set literal / tuple-or-list of str constants."""
+    if isinstance(node, ast.Call) and (
+        (isinstance(node.func, ast.Name) and node.func.id == "frozenset")
+        or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "frozenset"
+        )
+    ):
+        if not node.args:
+            return set()
+        return _str_set_from(node.args[0])
+    if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        out = set()
+        for el in node.elts:
+            s = _const_str(el)
+            if s is None:
+                return None
+            out.add(s)
+        return out
+    return None
+
+
+class _Parents(ast.NodeVisitor):
+    """node -> enclosing FunctionDef chain (innermost first)."""
+
+    def __init__(self):
+        self.func_of: Dict[ast.AST, Optional[ast.AST]] = {}
+        self._stack: List[ast.AST] = []
+
+    def generic_visit(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            self.func_of[node] = self._stack[-1] if self._stack else None
+            self._stack.append(node)
+            super().generic_visit(node)
+            self._stack.pop()
+        else:
+            self.func_of[node] = self._stack[-1] if self._stack else None
+            super().generic_visit(node)
+
+
+def _policy_sets(ctx: AnalysisContext) -> Dict[str, Tuple[str, int, Set[str]]]:
+    """{set_name: (path, line, methods)} from module-level assignments."""
+    found: Dict[str, Tuple[str, int, Set[str]]] = {}
+    for path, tree in ctx.trees():
+        for node in ast.walk(tree):
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                if isinstance(node.targets[0], ast.Name):
+                    target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    target, value = node.target.id, node.value
+            if target in _POLICY_SETS and value is not None:
+                methods = _str_set_from(value)
+                if methods is not None:
+                    found[target] = (path, node.lineno, methods)
+    return found
+
+
+def _dataclass_fields(tree: ast.AST) -> Dict[str, Set[str]]:
+    """{class name: field names} for @dataclass classes in a module."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        is_dc = any(
+            (isinstance(d, ast.Name) and d.id == "dataclass")
+            or (isinstance(d, ast.Attribute) and d.attr == "dataclass")
+            or (
+                isinstance(d, ast.Call)
+                and (
+                    (isinstance(d.func, ast.Name) and d.func.id == "dataclass")
+                    or (
+                        isinstance(d.func, ast.Attribute)
+                        and d.func.attr == "dataclass"
+                    )
+                )
+            )
+            for d in node.decorator_list
+        )
+        if not is_dc:
+            continue
+        fields = {
+            st.target.id
+            for st in node.body
+            if isinstance(st, ast.AnnAssign) and isinstance(st.target, ast.Name)
+        }
+        # single inheritance between request dataclasses is not used;
+        # the mixin base carries no fields, so direct fields suffice
+        out[node.name] = fields
+    return out
+
+
+def _wire_schemas(
+    ctx: AnalysisContext,
+) -> Tuple[Optional[str], int, Dict[str, Set[str]]]:
+    """(defining path, line, {method: field set}) or (None, 0, {})."""
+    for path, tree in ctx.trees():
+        classes = _dataclass_fields(tree)
+        for node in ast.walk(tree):
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                if isinstance(node.targets[0], ast.Name):
+                    target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    target, value = node.target.id, node.value
+            if target != _SCHEMA_MAP_NAME or not isinstance(value, ast.Dict):
+                continue
+            schemas: Dict[str, Set[str]] = {}
+            for k, v in zip(value.keys, value.values):
+                method = _const_str(k)
+                if method is None or not isinstance(v, ast.Name):
+                    continue
+                schemas[method] = classes.get(v.id, set())
+            return path, node.lineno, schemas
+    return None, 0, {}
+
+
+# -- handlers ----------------------------------------------------------------
+
+
+class _Handler:
+    def __init__(self, method, path, line, func, cls):
+        self.method = method
+        self.path = path
+        self.line = line
+        self.func = func  # FunctionDef or None
+        self.cls = cls  # ClassDef or None
+
+
+def _collect_handlers(ctx: AnalysisContext) -> Dict[str, _Handler]:
+    handlers: Dict[str, _Handler] = {}
+    for path, tree in ctx.trees():
+        module_funcs = {
+            n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+        }
+        for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+            methods = {
+                n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)
+            }
+            htab = methods.get("handlers")
+            if htab is None:
+                continue
+            for node in ast.walk(htab):
+                if not (isinstance(node, ast.Return) and isinstance(node.value, ast.Dict)):
+                    continue
+                for k, v in zip(node.value.keys, node.value.values):
+                    method = _const_str(k)
+                    if method is None:
+                        continue
+                    func = None
+                    if (
+                        isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id == "self"
+                    ):
+                        func = methods.get(v.attr)
+                    handlers[method] = _Handler(
+                        method, path, k.lineno, func, cls
+                    )
+        # RpcServer({...}) with an inline dict literal
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "RpcServer"
+                and node.args
+                and isinstance(node.args[0], ast.Dict)
+            ):
+                continue
+            for k, v in zip(node.args[0].keys, node.args[0].values):
+                method = _const_str(k)
+                if method is None or method in handlers:
+                    continue
+                func = module_funcs.get(v.id) if isinstance(v, ast.Name) else None
+                handlers[method] = _Handler(method, path, k.lineno, func, None)
+    return handlers
+
+
+# -- call sites --------------------------------------------------------------
+
+
+class _CallSite:
+    def __init__(self, method, path, line, request, func, idempotent_kw):
+        self.method = method
+        self.path = path
+        self.line = line
+        self.request = request  # the request expression node or None
+        self.func = func  # enclosing FunctionDef/Lambda or None
+        self.idempotent_kw = idempotent_kw  # True/False/None (not passed)
+
+
+def _collect_call_sites(ctx: AnalysisContext) -> List[_CallSite]:
+    sites: List[_CallSite] = []
+    for path, tree in ctx.trees():
+        parents = _Parents()
+        parents.visit(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            method = None
+            request = None
+            idem = None
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "call"
+                and node.args
+            ):
+                method = _const_str(node.args[0])
+                request = node.args[1] if len(node.args) > 1 else None
+                for kw in node.keywords:
+                    if kw.arg == "request":
+                        request = kw.value
+                    if kw.arg == "idempotent" and isinstance(
+                        kw.value, ast.Constant
+                    ):
+                        idem = kw.value.value
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "submit"
+                and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Attribute)
+                and node.args[0].attr == "call"
+            ):
+                # pool.submit(client.call, "Method", {...})
+                method = _const_str(node.args[1])
+                request = node.args[2] if len(node.args) > 2 else None
+            if method is None:
+                continue
+            sites.append(
+                _CallSite(
+                    method, path, node.lineno, request,
+                    parents.func_of.get(node), idem,
+                )
+            )
+    return sites
+
+
+_DYNAMIC = object()  # sentinel: request keys not statically resolvable
+
+
+def _request_keys(site: _CallSite):
+    """Literal key set of the request dict, following same-function
+    dataflow; _DYNAMIC when unresolvable; None for a missing request
+    (the client sends {})."""
+    req = site.request
+    if req is None:
+        return set()
+    if isinstance(req, ast.Dict):
+        keys = set()
+        for k in req.keys:
+            s = _const_str(k)
+            if s is None:
+                return _DYNAMIC  # **spread or computed key
+            keys.add(s)
+        return keys
+    if not isinstance(req, ast.Name) or site.func is None:
+        return _DYNAMIC
+    name = req.id
+    keys: Optional[Set[str]] = None
+    resolvable = True
+    for node in ast.walk(site.func):
+        # req = {...}  (a non-literal re-bind makes it dynamic)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    if isinstance(node.value, ast.Dict):
+                        base = _request_keys(
+                            _CallSite("", "", 0, node.value, None, None)
+                        )
+                        if base is _DYNAMIC:
+                            resolvable = False
+                        else:
+                            keys = (keys or set()) | base
+                    else:
+                        resolvable = False
+        # req["k"] = v
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Store)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == name
+        ):
+            s = _const_str(node.slice)
+            if s is None:
+                resolvable = False
+            else:
+                keys = (keys or set()) | {s}
+        # req.update({...})
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "update"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == name
+        ):
+            if node.args and isinstance(node.args[0], ast.Dict):
+                base = _request_keys(
+                    _CallSite("", "", 0, node.args[0], None, None)
+                )
+                if base is _DYNAMIC:
+                    resolvable = False
+                else:
+                    keys = (keys or set()) | base
+            else:
+                resolvable = False
+    if keys is None or not resolvable:
+        return _DYNAMIC
+    return keys
+
+
+# -- handler request reads ---------------------------------------------------
+
+
+def _handler_key_reads(
+    handler: _Handler, tree_funcs: Dict[str, ast.FunctionDef]
+) -> List[Tuple[str, int]]:
+    """(key, line) pairs the handler reads off its request parameter,
+    following the parameter through same-class/module helper calls."""
+    reads: List[Tuple[str, int]] = []
+    seen: Set[Tuple[str, str]] = set()
+
+    def visit(func: ast.FunctionDef, param: str, depth: int):
+        if func is None or depth > 3 or (func.name, param) in seen:
+            return
+        seen.add((func.name, param))
+        for node in ast.walk(func):
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == param
+            ):
+                s = _const_str(node.slice)
+                if s is not None:
+                    reads.append((s, node.lineno))
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == param
+                and node.args
+            ):
+                s = _const_str(node.args[0])
+                if s is not None:
+                    reads.append((s, node.lineno))
+            # helper(req) — follow the request into same-class/module fns
+            callee = None
+            self_offset = 0
+            if (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and handler.cls is not None
+            ):
+                callee = next(
+                    (
+                        m
+                        for m in handler.cls.body
+                        if isinstance(m, ast.FunctionDef)
+                        and m.name == node.func.attr
+                    ),
+                    None,
+                )
+                self_offset = 1
+            elif isinstance(node.func, ast.Name):
+                callee = tree_funcs.get(node.func.id)
+            if callee is None:
+                continue
+            for pos, arg in enumerate(node.args):
+                if isinstance(arg, ast.Name) and arg.id == param:
+                    idx = pos + self_offset
+                    if idx < len(callee.args.args):
+                        visit(callee, callee.args.args[idx].arg, depth + 1)
+
+    args = handler.func.args.args
+    if not args:
+        return reads
+    param = args[1].arg if args[0].arg == "self" and len(args) > 1 else args[0].arg
+    visit(handler.func, param, 0)
+    return reads
+
+
+# -- the rule ----------------------------------------------------------------
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    policy = _policy_sets(ctx)
+    schema_path, schema_line, schemas = _wire_schemas(ctx)
+    handlers = _collect_handlers(ctx)
+    sites = _collect_call_sites(ctx)
+    called = {s.method for s in sites}
+
+    idem = policy.get("IDEMPOTENT_METHODS")
+    dedup = policy.get("DEDUP_KEYED_METHODS")
+
+    def add(check, path, line, message):
+        findings.append(Finding(RULE, check, path, line, message))
+
+    # calls with no handler / handlers never called
+    if handlers:
+        for s in sites:
+            if s.method not in handlers:
+                add(
+                    "no-handler", s.path, s.line,
+                    f"RPC '{s.method}' is called but no handler table "
+                    f"registers it",
+                )
+        for method, h in sorted(handlers.items()):
+            if method not in called:
+                add(
+                    "unused-handler", h.path, h.line,
+                    f"handler for '{method}' is registered but never called",
+                )
+
+    # retry-policy classification
+    if idem is not None:
+        ipath, iline, imethods = idem
+        if handlers:
+            for m in sorted(imethods - set(handlers)):
+                add(
+                    "idempotent-no-handler", ipath, iline,
+                    f"IDEMPOTENT_METHODS lists '{m}' but no handler "
+                    f"registers it",
+                )
+        for m in sorted(imethods - called):
+            add(
+                "idempotent-never-called", ipath, iline,
+                f"IDEMPOTENT_METHODS lists '{m}' but nothing calls it — "
+                f"stale classification",
+            )
+        for s in sites:
+            if s.idempotent_kw is True and s.method not in imethods:
+                add(
+                    "retry-unclassified", s.path, s.line,
+                    f"'{s.method}' is forced idempotent=True at this call "
+                    f"but is not in IDEMPOTENT_METHODS — re-send safety "
+                    f"is unproven",
+                )
+    if dedup is not None and idem is not None:
+        dpath, dline, dmethods = dedup
+        for m in sorted(dmethods - idem[2]):
+            add(
+                "dedup-not-idempotent", dpath, dline,
+                f"DEDUP_KEYED_METHODS lists '{m}' outside "
+                f"IDEMPOTENT_METHODS — a dedup key only matters for "
+                f"re-sendable methods",
+            )
+
+    # request-shape checks
+    for s in sites:
+        keys = _request_keys(s)
+        if keys is _DYNAMIC:
+            continue
+        if dedup is not None and s.method in dedup[2]:
+            if "report_key" not in keys:
+                add(
+                    "missing-dedup-key", s.path, s.line,
+                    f"'{s.method}' is retried relying on shard-side dedup "
+                    f"but this request carries no 'report_key' — a resend "
+                    f"would double-apply",
+                )
+        if s.method in schemas:
+            for k in sorted(keys - schemas[s.method]):
+                add(
+                    "unknown-request-key", s.path, s.line,
+                    f"request for '{s.method}' sends key '{k}' absent "
+                    f"from its wire dataclass",
+                )
+
+    # handler reads vs the schema
+    for method, h in sorted(handlers.items()):
+        if h.func is None or method not in schemas:
+            continue
+        tree_funcs = {}
+        sf = ctx.files.get(h.path)
+        if sf is not None and sf.tree is not None:
+            tree_funcs = {
+                n.name: n
+                for n in sf.tree.body
+                if isinstance(n, ast.FunctionDef)
+            }
+        seen_keys = set()
+        for key, line in _handler_key_reads(h, tree_funcs):
+            if key in schemas[method] or (method, key) in seen_keys:
+                continue
+            seen_keys.add((method, key))
+            add(
+                "handler-unknown-key", h.path, line,
+                f"handler for '{method}' reads request key '{key}' absent "
+                f"from its wire dataclass",
+            )
+
+    # WIRE_SCHEMAS <-> handlers: exact match both ways
+    if schemas and handlers:
+        for m in sorted(set(schemas) - set(handlers)):
+            add(
+                "schema-no-handler", schema_path, schema_line,
+                f"WIRE_SCHEMAS declares '{m}' but no handler registers it",
+            )
+        for m in sorted(set(handlers) - set(schemas)):
+            h = handlers[m]
+            add(
+                "handler-no-schema", h.path, h.line,
+                f"handler for '{m}' has no WIRE_SCHEMAS entry — its "
+                f"request shape is undeclared",
+            )
+    return findings

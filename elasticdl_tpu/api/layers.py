@@ -19,7 +19,7 @@ to power-of-two buckets (SURVEY §7.3 item 1).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np

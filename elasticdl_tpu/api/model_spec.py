@@ -31,7 +31,6 @@ import dataclasses
 import importlib
 import importlib.util
 import os
-import sys
 from typing import Any, Callable, Dict, List, Optional
 
 from elasticdl_tpu.api.layers import EmbeddingSpec

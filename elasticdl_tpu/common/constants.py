@@ -49,3 +49,78 @@ class Mode(object):
 # Worker gives up on a minibatch after this many stale-gradient retries
 # (reference: elasticdl/python/worker/worker.py:20).
 MAX_MINIBATCH_RETRY_NUM = 64
+
+
+# -- environment-variable registry ------------------------------------------
+#
+# Every EDL_*/K8S_* environment variable the framework reads, by name.
+# Code must read env vars through these constants, and every constant
+# must be registered in ENV_REGISTRY with a one-line description: the
+# env-registry lint (elasticdl_tpu/analysis/env_registry.py) fails CI
+# on any read of an EDL_*/K8S_* variable that is not declared here, so
+# the table below is, by construction, the complete operator surface.
+
+ENV_CHAOS_SPEC = "EDL_CHAOS_SPEC"
+ENV_CHAOS_ROLE = "EDL_CHAOS_ROLE"
+ENV_CHAOS_TARGET_ID = "EDL_CHAOS_TARGET_ID"
+ENV_RPC_RETRIES = "EDL_RPC_RETRIES"
+ENV_RPC_BACKOFF = "EDL_RPC_BACKOFF"
+ENV_RPC_SEED = "EDL_RPC_SEED"
+ENV_SYNC_DEPTH = "EDL_SYNC_DEPTH"
+ENV_BET_PREFETCH = "EDL_BET_PREFETCH"
+ENV_BENCH_MFU = "EDL_BENCH_MFU"
+ENV_WORKER_LOG_DIR = "EDL_WORKER_LOG_DIR"
+ENV_TB_BACKEND = "EDL_TPU_TB_BACKEND"
+ENV_NO_NATIVE_KV = "EDL_TPU_NO_NATIVE_KV"
+ENV_TPU_FLASH = "EDL_TPU_FLASH"
+ENV_TPU_TESTS = "EDL_TPU_TESTS"
+ENV_K8S_TESTS = "K8S_TESTS"
+ENV_K8S_TEST_IMAGE = "K8S_TEST_IMAGE"
+ENV_K8S_TEST_NAMESPACE = "K8S_TEST_NAMESPACE"
+
+ENV_REGISTRY = {
+    ENV_CHAOS_SPEC: (
+        "chaos activation: inline FaultPlan JSON or @/path/to/spec.json "
+        "(rpc/chaos.py); inherited by every spawned subprocess"
+    ),
+    ENV_CHAOS_ROLE: (
+        "chaos scoping: this process's role (worker/ps/kv/master), "
+        "stamped by the spawner"
+    ),
+    ENV_CHAOS_TARGET_ID: (
+        "chaos scoping: this process's target id (worker/shard index), "
+        "stamped by the spawner"
+    ),
+    ENV_RPC_RETRIES: "RetryPolicy max_attempts override (>=1; 1 = no retries)",
+    ENV_RPC_BACKOFF: "RetryPolicy initial backoff seconds override",
+    ENV_RPC_SEED: "RetryPolicy deterministic-jitter seed override",
+    ENV_SYNC_DEPTH: (
+        "max in-flight pipelined window syncs per worker (0 serializes; "
+        "default 2)"
+    ),
+    ENV_BET_PREFETCH: (
+        "0 disables the batched-embedding-training lookup prefetch "
+        "overlap (default on)"
+    ),
+    ENV_BENCH_MFU: "1 prints per-step MFU accounting from the worker hot loop",
+    ENV_WORKER_LOG_DIR: (
+        "directory for per-worker log files under the ProcessBackend "
+        "(empty = inherit stdio)"
+    ),
+    ENV_TB_BACKEND: (
+        "TensorBoard event-writer backend override "
+        "(master/tensorboard_service.py)"
+    ),
+    ENV_NO_NATIVE_KV: (
+        "1 disables the C++ embedding-store arena, forcing the "
+        "lock-striped Python store"
+    ),
+    ENV_TPU_FLASH: (
+        "force the Pallas flash-attention kernels on (1) or off (0); "
+        "unset = size heuristic"
+    ),
+    ENV_TPU_TESTS: "1 enables hardware-gated tests (tests/test_cluster_gated.py)",
+    ENV_K8S_TESTS: "1 enables live-cluster tests (tests/test_cluster_gated.py)",
+    ENV_K8S_TEST_IMAGE: "worker image for the live-cluster tests",
+    ENV_K8S_TEST_NAMESPACE: "namespace for the live-cluster tests",
+}

@@ -12,7 +12,7 @@ Redis side channel (elasticdl/python/master/embedding_service.py:270-357).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 from elasticdl_tpu.common import codec
 
@@ -76,6 +76,225 @@ class Model:
     @classmethod
     def from_wire(cls, d: dict) -> "Model":
         return cls(version=d["version"], params=d["params"], aux=d.get("aux"))
+
+
+class _WireRequest:
+    """Shared to_wire/from_wire for the request dataclasses below.
+
+    from_wire ignores unknown keys on purpose: an old server must keep
+    decoding requests from a newer client that added an optional field
+    (the same forward-compatibility protobuf gives for free)."""
+
+    def to_wire(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict):
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+
+@dataclasses.dataclass
+class GetTaskRequest(_WireRequest):
+    worker_id: int = -1
+
+
+@dataclasses.dataclass
+class GetModelRequest(_WireRequest):
+    version: int = 0
+    method: str = MethodType.MINIMUM
+    flat: bool = False
+    only_if_newer: bool = False
+    model_dtype: Optional[str] = None
+
+
+@dataclasses.dataclass
+class GetAuxRequest(_WireRequest):
+    pass
+
+
+@dataclasses.dataclass
+class GetPSConfigRequest(_WireRequest):
+    pass
+
+
+@dataclasses.dataclass
+class GetSampleBatchRequest(_WireRequest):
+    n: int = 1
+
+
+@dataclasses.dataclass
+class ReportVariableRequest(_WireRequest):
+    params: Any = None
+    aux: Any = None
+
+
+@dataclasses.dataclass
+class ReportGradientRequest(_WireRequest):
+    worker_id: int = -1
+    version: int = -1
+    gradient: Any = None  # pytree of arrays (tree transport)
+    gradient_flat: Any = None  # raveled vector (flat transport)
+    edl_gradient: Any = None  # {layer: IndexedRows}
+    aux_state: Any = None
+    loss: Any = None
+    return_model: bool = False
+    model_dtype: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ReportLocalUpdateRequest(_WireRequest):
+    steps: int = 0
+    base_version: int = -1
+    delta_flat: Any = None
+    edl_gradient: Any = None
+    aux_state: Any = None
+    loss: Any = None
+    want_model: bool = False
+    model_dtype: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ReportEvaluationMetricsRequest(_WireRequest):
+    model_version: int = -1
+    metrics: Any = None
+    num_examples: int = 1
+
+
+@dataclasses.dataclass
+class ReportTaskResultRequest(_WireRequest):
+    task_id: int = -1
+    err_message: str = ""
+    worker_id: int = -1
+
+
+@dataclasses.dataclass
+class ReportWindowMetaRequest(_WireRequest):
+    worker_id: int = -1
+    versions: Any = None  # per-shard versions after the pushes
+    steps: int = 0
+    aux_state: Any = None
+    edl_gradient: Any = None
+    loss: Any = None
+    want_aux: bool = False
+
+
+@dataclasses.dataclass
+class EmbeddingLookupRequest(_WireRequest):
+    layer: str = ""
+    ids: Any = None
+
+
+@dataclasses.dataclass
+class EmbeddingUpdateRequest(_WireRequest):
+    layer: str = ""
+    ids: Any = None
+    values: Any = None
+    set_if_not_exist: bool = False
+
+
+@dataclasses.dataclass
+class PSInitRequest(_WireRequest):
+    vec: Any = None
+    version: int = 0
+
+
+@dataclasses.dataclass
+class PSPullRequest(_WireRequest):
+    only_if_newer: bool = False
+    version: int = -1
+    model_dtype: Optional[str] = None
+
+
+@dataclasses.dataclass
+class PSPushGradRequest(_WireRequest):
+    grad: Any = None
+    version: int = -1
+    return_model: bool = False
+    report_key: str = ""
+    model_dtype: Optional[str] = None
+
+
+@dataclasses.dataclass
+class PSPushDeltaRequest(_WireRequest):
+    delta: Any = None
+    steps: int = 0
+    base_version: int = -1
+    want_model: bool = False
+    report_key: str = ""
+    model_dtype: Optional[str] = None
+
+
+@dataclasses.dataclass
+class PSOptStateRequest(_WireRequest):
+    pass
+
+
+@dataclasses.dataclass
+class PSOptRestoreRequest(_WireRequest):
+    leaves: Any = None
+
+
+@dataclasses.dataclass
+class KVLookupRequest(_WireRequest):
+    layer: str = ""
+    ids: Any = None
+
+
+@dataclasses.dataclass
+class KVUpdateRequest(_WireRequest):
+    layer: str = ""
+    ids: Any = None
+    values: Any = None
+    set_if_not_exist: bool = False
+
+
+@dataclasses.dataclass
+class KVSnapshotRequest(_WireRequest):
+    pass
+
+
+@dataclasses.dataclass
+class KVRestoreRequest(_WireRequest):
+    layers: Any = None  # {layer: {"ids": [n], "values": [n, dim]}}
+
+
+@dataclasses.dataclass
+class KVLenRequest(_WireRequest):
+    pass
+
+
+#: The declared request contract, method name -> wire dataclass. The
+#: rpc-conformance lint (elasticdl_tpu/analysis/rpc_conformance.py)
+#: checks every client call-site dict and every server handler read
+#: against these fields, so schema drift fails CI instead of surfacing
+#: as a KeyError mid-job.
+WIRE_SCHEMAS: Dict[str, type] = {
+    "GetTask": GetTaskRequest,
+    "GetModel": GetModelRequest,
+    "GetAux": GetAuxRequest,
+    "GetPSConfig": GetPSConfigRequest,
+    "GetSampleBatch": GetSampleBatchRequest,
+    "ReportVariable": ReportVariableRequest,
+    "ReportGradient": ReportGradientRequest,
+    "ReportLocalUpdate": ReportLocalUpdateRequest,
+    "ReportEvaluationMetrics": ReportEvaluationMetricsRequest,
+    "ReportTaskResult": ReportTaskResultRequest,
+    "ReportWindowMeta": ReportWindowMetaRequest,
+    "EmbeddingLookup": EmbeddingLookupRequest,
+    "EmbeddingUpdate": EmbeddingUpdateRequest,
+    "PSInit": PSInitRequest,
+    "PSPull": PSPullRequest,
+    "PSPushGrad": PSPushGradRequest,
+    "PSPushDelta": PSPushDeltaRequest,
+    "PSOptState": PSOptStateRequest,
+    "PSOptRestore": PSOptRestoreRequest,
+    "KVLookup": KVLookupRequest,
+    "KVUpdate": KVUpdateRequest,
+    "KVSnapshot": KVSnapshotRequest,
+    "KVRestore": KVRestoreRequest,
+    "KVLen": KVLenRequest,
+}
 
 
 def pack(obj: Any) -> bytes:

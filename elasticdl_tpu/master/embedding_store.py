@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticdl_tpu.common.constants import ENV_NO_NATIVE_KV
 from elasticdl_tpu.common.log_util import get_logger
 
 logger = get_logger(__name__)
@@ -98,7 +99,7 @@ class EmbeddingStore:
     def __new__(cls, *args, **kwargs):
         if cls is EmbeddingStore:
             native = (
-                os.environ.get("EDL_TPU_NO_NATIVE_KV") != "1"
+                os.environ.get(ENV_NO_NATIVE_KV) != "1"
                 and _load_native() is not None
             )
             impl = NativeEmbeddingStore if native else PyEmbeddingStore

@@ -147,8 +147,9 @@ class EvaluationService:
     def add_evaluation_task_if_needed(self, version: int, prev_version=None):
         """Step-based trigger (reference: :165-173). Floor-crossing so
         multi-step bumps (local-update syncs) don't skip evals."""
-        if not self._eval_steps or version <= self._last_eval_version:
-            return
+        with self._lock:
+            if not self._eval_steps or version <= self._last_eval_version:
+                return
         prev = prev_version if prev_version is not None else version - 1
         if version // self._eval_steps > prev // self._eval_steps:
             self.add_evaluation_task()

@@ -13,8 +13,6 @@ from __future__ import annotations
 
 import os
 import subprocess
-import sys
-import tempfile
 import time
 from typing import List, Optional
 

@@ -31,7 +31,11 @@ from elasticdl_tpu.common.args import (
     validate_master_args,
     worker_forward_args,
 )
-from elasticdl_tpu.common.constants import JobType, WorkerManagerStatus
+from elasticdl_tpu.common.constants import (
+    ENV_WORKER_LOG_DIR,
+    JobType,
+    WorkerManagerStatus,
+)
 from elasticdl_tpu.common.log_util import get_logger
 
 logger = get_logger(__name__)
@@ -347,7 +351,9 @@ def make_backend(args):
     if args.worker_backend == "process":
         from elasticdl_tpu.cluster.pod_backend import ProcessBackend
 
-        return ProcessBackend(log_dir=os.environ.get("EDL_WORKER_LOG_DIR", ""))
+        return ProcessBackend(
+            log_dir=os.environ.get(ENV_WORKER_LOG_DIR, "")
+        )
     from elasticdl_tpu.cluster.k8s_backend import K8sBackend
 
     return K8sBackend(

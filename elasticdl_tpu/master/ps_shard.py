@@ -144,10 +144,12 @@ class PSShardServicer:
 
     @property
     def version(self) -> int:
-        return self._version
+        with self._lock:
+            return self._version
 
     def initialized(self) -> bool:
-        return self._vec is not None
+        with self._lock:
+            return self._vec is not None
 
     # -- RPCs ----------------------------------------------------------------
 
@@ -291,13 +293,13 @@ class PSShardServicer:
         self._applied_pushes += 1
         return False
 
-    def _wire_vec(self, req: dict) -> np.ndarray:
+    def _wire_vec(self, req: dict) -> np.ndarray:  # edl-lint: disable=lock-discipline -- caller holds self._lock
         dtype = req.get("model_dtype")
         if dtype and dtype != "float32":
             return self._vec.astype(codec.dtype_from_str(dtype))
         return self._vec.copy()
 
-    def _apply(self, grad: np.ndarray):
+    def _apply(self, grad: np.ndarray):  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """Optimizer step on the slice (caller holds the lock).
         Elementwise optimizers make the slice-wise apply exact."""
         if self._opt is not None:

@@ -152,13 +152,15 @@ class MasterServicer:
 
     @property
     def version(self) -> int:
-        return self._version
+        with self._lock:
+            return self._version
 
     def model_initialized(self) -> bool:
-        return self._params is not None
+        with self._lock:
+            return self._params is not None
 
     def get_params_copy(self):
-        if self._ps_group is not None and self._params is not None:
+        if self._ps_group is not None and self.model_initialized():
             # assemble the authoritative values from the shards; the
             # master's tree is only the template. Slices are pulled
             # concurrently and may straddle a step (relaxed snapshot —
@@ -173,8 +175,9 @@ class MasterServicer:
             if vec is not None:
                 with self._lock:
                     aux = jax.tree_util.tree_map(np.copy, self._aux)
+                    template = self._params
                 return (
-                    codec.unravel_np(vec, self._params),
+                    codec.unravel_np(vec, template),
                     aux,
                     min(versions),
                 )
@@ -313,16 +316,18 @@ class MasterServicer:
         (reference: servicer.py:299-303). In sharded mode the master
         keeps the tree as the assembly template and seeds the shards
         (their SETNX makes racing initializers harmless)."""
+        seed_flat = None
         with self._lock:
             first = self._params is None
             if first:
                 self._params = _to_f32(req["params"])
                 if req.get("aux") is not None:
                     self._aux = req["aux"]
-        if first and self._ps_group is not None:
-            self._ps_group.ensure_init(
-                codec.ravel_np(self._params), self._version
-            )
+                if self._ps_group is not None:
+                    seed_flat = codec.ravel_np(self._params)
+            seed_version = self._version
+        if seed_flat is not None:
+            self._ps_group.ensure_init(seed_flat, seed_version)
         return {}
 
     # -- RPC: gradients (the hot path) --------------------------------------
@@ -391,8 +396,9 @@ class MasterServicer:
                     self._pending_aux = aux_state
                 self._grad_n += 1
                 if self._grad_n >= self._grads_to_wait:
+                    n = float(self._grad_n)
                     avg = jax.tree_util.tree_map(
-                        lambda s: s / self._grad_n, self._grad_sum
+                        lambda s: s / n, self._grad_sum
                     )
                     merged = {
                         layer: merge_indexed_rows(irs)
@@ -600,7 +606,7 @@ class MasterServicer:
         self._report_train_loss(max(version, prev), req.get("loss"))
         return resp
 
-    def _flat_model(self, model_dtype=None):
+    def _flat_model(self, model_dtype=None):  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """Raveled params, optionally narrowed to the worker's wire
         dtype (bf16 halves the piggyback bytes; the worker re-widens —
         standard mixed-precision weight transport)."""
@@ -616,7 +622,7 @@ class MasterServicer:
             with self._sparse_lock:
                 self._sparse_opt.apply_gradients(edl_grads)
 
-    def _validate(self, grads):
+    def _validate(self, grads):  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """Shape sanity checks (reference: servicer.py:320-370)."""
         if grads is None:
             return
@@ -631,7 +637,7 @@ class MasterServicer:
                     f"{np.asarray(p).shape}"
                 )
 
-    def _apply(self, dense_grads, dense_scale: float = 1.0, aux_state=None):
+    def _apply(self, dense_grads, dense_scale: float = 1.0, aux_state=None):  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """DENSE optimizer step + version bump (caller holds the lock;
         reference: servicer.py:169-229, 398-402). Non-trainable state
         (BN moving stats) is last-writer-wins from the reporting hosts.

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.common.messages import Task, TaskType
@@ -105,7 +105,7 @@ class TaskDispatcher:
     def _create_tasks_no_lock(self, shards, task_type, model_version=-1):
         self._extend_todo(self._shard_to_tasks(shards, task_type, model_version))
 
-    def _extend_todo(self, tasks):
+    def _extend_todo(self, tasks):  # edl-lint: disable=lock-discipline -- caller holds self._lock
         for t in tasks:
             self._task_id += 1
             t.task_id = self._task_id

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from elasticdl_tpu.common.constants import ENV_TB_BACKEND
 from elasticdl_tpu.common.log_util import get_logger
 
 logger = get_logger(__name__)
@@ -80,7 +81,7 @@ class TensorBoardService:
     def __init__(self, logdir: str, backend: str = "auto"):
         self.logdir = logdir
         # EDL_TPU_TB_BACKEND overrides: "torch" (tfevents), "jsonl"
-        backend = os.environ.get("EDL_TPU_TB_BACKEND", backend)
+        backend = os.environ.get(ENV_TB_BACKEND, backend)
         self._writer = _make_writer(logdir, backend)
         self._tb_proc: Optional[subprocess.Popen] = None
         logger.info(

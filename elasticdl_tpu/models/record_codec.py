@@ -10,7 +10,7 @@ batch) keeps the host input path off the critical step time.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -350,8 +350,10 @@ def attention(q, k, v, causal: bool = True):
     (tests/test_flash_attention.py)."""
     import os
 
+    from elasticdl_tpu.common.constants import ENV_TPU_FLASH
+
     b, L, h, _d = q.shape
-    flag = os.environ.get("EDL_TPU_FLASH")
+    flag = os.environ.get(ENV_TPU_FLASH)
     if jax.default_backend() == "tpu" and L % BLOCK == 0 and flag != "0":
         score_bytes = 2.5 * b * h * L * L * 2  # bf16 probs, fwd+bwd copies
         if flag == "1" or score_bytes > FLASH_SCORE_BYTES:

@@ -64,14 +64,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
 
+from elasticdl_tpu.common.constants import (
+    ENV_CHAOS_ROLE,
+    ENV_CHAOS_SPEC,
+    ENV_CHAOS_TARGET_ID,
+)
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.rpc.policy import PolicyRpcError
 
 logger = get_logger(__name__)
-
-ENV_SPEC = "EDL_CHAOS_SPEC"
-ENV_ROLE = "EDL_CHAOS_ROLE"
-ENV_TARGET = "EDL_CHAOS_TARGET_ID"
 
 #: exit code used by `crash` faults: distinct from clean exits (0),
 #: crashes (1), EXIT_CODE_JOB_FAILED (2) and EXIT_CODE_MASTER_UNREACHABLE
@@ -165,7 +166,7 @@ class FaultPlan:
     def from_env(cls, env=None) -> Optional["FaultPlan"]:
         """The env-var activation path (None when chaos is off)."""
         env = os.environ if env is None else env
-        raw = env.get(ENV_SPEC, "").strip()
+        raw = env.get(ENV_CHAOS_SPEC, "").strip()
         if not raw:
             return None
         try:
@@ -175,13 +176,13 @@ class FaultPlan:
             spec = json.loads(raw)
             return cls.from_spec(
                 spec,
-                role=env.get(ENV_ROLE, ""),
-                target_id=env.get(ENV_TARGET, ""),
+                role=env.get(ENV_CHAOS_ROLE, ""),
+                target_id=env.get(ENV_CHAOS_TARGET_ID, ""),
             )
         except Exception:
             # a malformed spec must never take down a training process;
             # chaos silently off beats chaos-induced config outages
-            logger.exception("ignoring malformed %s", ENV_SPEC)
+            logger.exception("ignoring malformed %s", ENV_CHAOS_SPEC)
             return None
 
     # -- firing logic --------------------------------------------------------
@@ -344,7 +345,7 @@ def chaos_env_for(role: str, target_id: Optional[object] = None) -> Dict[str, st
     """Env tags a spawner stamps onto a child process so the inherited
     EDL_CHAOS_SPEC applies with the right role/target scoping. Cheap and
     unconditional: the tags are inert when no spec is set."""
-    env = {ENV_ROLE: role}
+    env = {ENV_CHAOS_ROLE: role}
     if target_id is not None:
-        env[ENV_TARGET] = str(target_id)
+        env[ENV_CHAOS_TARGET_ID] = str(target_id)
     return env

@@ -39,6 +39,12 @@ from typing import Callable, FrozenSet, Optional
 
 import grpc
 
+from elasticdl_tpu.common.constants import (
+    ENV_RPC_BACKOFF,
+    ENV_RPC_RETRIES,
+    ENV_RPC_SEED,
+)
+
 #: Status codes worth re-sending an idempotent call for. INTERNAL is
 #: deliberately absent: a handler exception is deterministic — retrying
 #: re-raises it N times and hides the real error.
@@ -77,6 +83,17 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
         "KVRestore",
         "KVLen",
     }
+)
+
+#: Idempotent MUTATIONS that are only safe to re-send because the
+#: receiving shard dedups on a per-report `report_key`
+#: (ps_shard._is_duplicate). Every call site of these methods MUST put
+#: a `report_key` in the request dict — the rpc-conformance lint
+#: (analysis/rpc_conformance.py) fails CI on one that doesn't, because
+#: a keyless push whose first attempt WAS applied would double-apply on
+#: retry.
+DEDUP_KEYED_METHODS: FrozenSet[str] = frozenset(
+    {"PSPushGrad", "PSPushDelta"}
 )
 
 
@@ -138,12 +155,12 @@ class RetryPolicy:
     def from_env(cls, env=None) -> "RetryPolicy":
         env = os.environ if env is None else env
         kw = {}
-        if env.get("EDL_RPC_RETRIES"):
-            kw["max_attempts"] = max(1, int(env["EDL_RPC_RETRIES"]))
-        if env.get("EDL_RPC_BACKOFF"):
-            kw["initial_backoff"] = float(env["EDL_RPC_BACKOFF"])
-        if env.get("EDL_RPC_SEED"):
-            kw["seed"] = int(env["EDL_RPC_SEED"])
+        if env.get(ENV_RPC_RETRIES):
+            kw["max_attempts"] = max(1, int(env[ENV_RPC_RETRIES]))
+        if env.get(ENV_RPC_BACKOFF):
+            kw["initial_backoff"] = float(env[ENV_RPC_BACKOFF])
+        if env.get(ENV_RPC_SEED):
+            kw["seed"] = int(env[ENV_RPC_SEED])
         return cls(**kw)
 
     def backoff_for(self, method: str, attempt: int) -> float:

@@ -38,7 +38,13 @@ from elasticdl_tpu.api.layers import (
     prepare_batch_embedding,
 )
 from elasticdl_tpu.api.model_spec import ModelSpec
-from elasticdl_tpu.common.constants import MAX_MINIBATCH_RETRY_NUM, Mode
+from elasticdl_tpu.common.constants import (
+    ENV_BENCH_MFU,
+    ENV_BET_PREFETCH,
+    ENV_SYNC_DEPTH,
+    MAX_MINIBATCH_RETRY_NUM,
+    Mode,
+)
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.common.timing import PhaseTimers
 from elasticdl_tpu.common.messages import MethodType, Task, TaskType
@@ -147,10 +153,10 @@ class Worker:
         # relaunch budget would burn on a typo): fall back to 2.
         try:
             self._max_inflight_syncs = max(
-                0, int(os.environ.get("EDL_SYNC_DEPTH", "2").strip())
+                0, int(os.environ.get(ENV_SYNC_DEPTH, "2").strip())
             )
         except ValueError:
-            logger.warning("ignoring malformed EDL_SYNC_DEPTH; using 2")
+            logger.warning("ignoring malformed %s; using 2", ENV_SYNC_DEPTH)
             self._max_inflight_syncs = 2
         self._sync_seq = 0  # spawn counter: tags piggyback results
         self._synced_seq = 0  # highest seq whose delta landed on the PS
@@ -983,7 +989,7 @@ class Worker:
             # the overlap off (bench A/B knob).
             prefetch_on = (
                 self._max_inflight_syncs > 0
-                and os.environ.get("EDL_BET_PREFETCH", "1") != "0"
+                and os.environ.get(ENV_BET_PREFETCH, "1") != "0"
             )
 
             def fetch(b):
@@ -1854,7 +1860,7 @@ class Worker:
         tx = self._spec.optimizer()
         opt_state = tx.init(self._flat)
         self.window_flops = None
-        if os.environ.get("EDL_BENCH_MFU") == "1":
+        if os.environ.get(ENV_BENCH_MFU) == "1":
             # XLA's own FLOP count for the compiled window — benches
             # report MFU from it (SURVEY §6: MFU is part of the perf
             # contract). Opt-in: .lower().compile() builds a SECOND

@@ -17,11 +17,13 @@ import numpy as np
 import pytest
 
 from elasticdl_tpu.rpc import chaos
+from elasticdl_tpu.common.constants import (
+    ENV_CHAOS_ROLE as ENV_ROLE,
+    ENV_CHAOS_SPEC as ENV_SPEC,
+    ENV_CHAOS_TARGET_ID as ENV_TARGET,
+)
 from elasticdl_tpu.rpc.chaos import (
     CHAOS_CRASH_EXIT_CODE,
-    ENV_ROLE,
-    ENV_SPEC,
-    ENV_TARGET,
     FaultPlan,
     InjectedRpcError,
     chaos_env_for,

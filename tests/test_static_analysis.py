@@ -1,0 +1,501 @@
+"""edl-lint suite tests (tier-1).
+
+Per-rule positive/negative fixture trees prove each family fires on a
+violation and stays silent on the clean twin; the CLI tests prove both
+exit-code directions; the repo tests pin the conformance invariants
+the lint exists to hold (every called method has a handler, the retry
+classification matches rpc/policy.py, the live tree is lint-clean).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from elasticdl_tpu.analysis import RULE_FAMILIES, run_analysis
+from elasticdl_tpu.analysis.__main__ import main as lint_main
+from elasticdl_tpu.analysis.core import load_context
+from elasticdl_tpu.analysis import rpc_conformance as rc
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_ROOT = os.path.join(REPO_ROOT, "elasticdl_tpu")
+
+
+def _tree(tmp_path, files):
+    for rel, source in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(source)
+    return str(tmp_path)
+
+
+def _checks(findings, rule):
+    return {f.check for f in findings if f.rule == rule}
+
+
+# -- rpc-conformance ---------------------------------------------------------
+
+RPC_GOOD = """
+class S:
+    def handlers(self):
+        return {"Ping": self.ping}
+
+    def ping(self, req):
+        return {"x": req.get("x")}
+
+
+def go(client):
+    client.call("Ping", {"x": 1})
+"""
+
+RPC_BAD_NO_HANDLER = """
+class S:
+    def handlers(self):
+        return {"Ping": self.ping}
+
+    def ping(self, req):
+        return {}
+
+
+def go(client):
+    client.call("Ping", {})
+    client.call("Pong", {"x": 1})
+"""
+
+RPC_BAD_SCHEMA = """
+import dataclasses
+
+
+@dataclasses.dataclass
+class PingRequest:
+    x: int = 0
+
+
+WIRE_SCHEMAS = {"Ping": PingRequest}
+
+
+class S:
+    def handlers(self):
+        return {"Ping": self.ping}
+
+    def ping(self, req):
+        return {"a": req["x"], "b": req.get("ghost")}
+
+
+def go(client):
+    client.call("Ping", {"x": 1, "bogus": 2})
+"""
+
+RPC_BAD_POLICY = """
+IDEMPOTENT_METHODS = frozenset({"Ping", "Phantom"})
+DEDUP_KEYED_METHODS = {"Push"}
+
+
+class S:
+    def handlers(self):
+        return {"Ping": self.ping, "Push": self.push}
+
+    def ping(self, req):
+        return {}
+
+    def push(self, req):
+        return {}
+
+
+def go(client):
+    client.call("Ping", {})
+    client.call("Push", {"grad": 1})
+    client.call("Ping", {}, idempotent=True)
+"""
+
+
+def test_rpc_conformance_clean(tmp_path):
+    root = _tree(tmp_path, {"mod.py": RPC_GOOD})
+    assert run_analysis(root, rules=["rpc-conformance"]) == []
+
+
+def test_rpc_conformance_no_handler_and_unused(tmp_path):
+    root = _tree(tmp_path, {"mod.py": RPC_BAD_NO_HANDLER})
+    checks = _checks(run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance")
+    assert "no-handler" in checks  # Pong called, never registered
+
+
+def test_rpc_conformance_unused_handler(tmp_path):
+    src = RPC_BAD_NO_HANDLER.replace('client.call("Pong", {"x": 1})', "pass")
+    src = src.replace('client.call("Ping", {})\n', "")
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance")
+    assert "unused-handler" in checks
+
+
+def test_rpc_conformance_schema_keys(tmp_path):
+    root = _tree(tmp_path, {"mod.py": RPC_BAD_SCHEMA})
+    checks = _checks(run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance")
+    assert "unknown-request-key" in checks  # call sends 'bogus'
+    assert "handler-unknown-key" in checks  # handler reads 'ghost'
+
+
+def test_rpc_conformance_policy_checks(tmp_path):
+    root = _tree(tmp_path, {"mod.py": RPC_BAD_POLICY})
+    checks = _checks(run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance")
+    assert "idempotent-no-handler" in checks  # Phantom classified, unregistered
+    assert "dedup-not-idempotent" in checks  # Push dedup-keyed, not idempotent
+    assert "missing-dedup-key" in checks  # Push request lacks report_key
+
+
+def test_rpc_conformance_retry_unclassified(tmp_path):
+    src = RPC_BAD_POLICY.replace(
+        'IDEMPOTENT_METHODS = frozenset({"Ping", "Phantom"})',
+        'IDEMPOTENT_METHODS = frozenset({"Push"})',
+    ).replace('DEDUP_KEYED_METHODS = {"Push"}', "DEDUP_KEYED_METHODS = set()")
+    src = src.replace('client.call("Push", {"grad": 1})', "pass")
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance")
+    assert "retry-unclassified" in checks  # idempotent=True outside the set
+
+
+def test_rpc_conformance_executor_form(tmp_path):
+    src = RPC_BAD_NO_HANDLER.replace(
+        'client.call("Pong", {"x": 1})',
+        'pool.submit(client.call, "Pong", {"x": 1})',
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance")
+    assert "no-handler" in checks
+
+
+def test_rpc_conformance_dynamic_request_skipped(tmp_path):
+    # an unresolvable request dict must be skipped, not guessed at
+    src = RPC_BAD_SCHEMA.replace(
+        'client.call("Ping", {"x": 1, "bogus": 2})',
+        'client.call("Ping", build_request())',
+    ).replace('"b": req.get("ghost")', '"b": 0')
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance")
+    assert "unknown-request-key" not in checks
+
+
+# -- lock-discipline ---------------------------------------------------------
+
+LOCK_BAD = """
+import threading
+import time
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n
+
+    def slow_bump(self):
+        with self._lock:
+            time.sleep(0.1)
+            self._n += 1
+"""
+
+LOCK_GOOD = """
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        with self._lock:
+            return self._n
+"""
+
+
+def test_lock_discipline_flags_unguarded_and_blocking(tmp_path):
+    root = _tree(tmp_path, {"mod.py": LOCK_BAD})
+    findings = run_analysis(root, rules=["lock-discipline"])
+    checks = _checks(findings, "lock-discipline")
+    assert "unguarded-access" in checks  # peek reads self._n lock-free
+    assert "blocking-under-lock" in checks  # time.sleep inside the lock
+
+
+def test_lock_discipline_clean(tmp_path):
+    root = _tree(tmp_path, {"mod.py": LOCK_GOOD})
+    assert run_analysis(root, rules=["lock-discipline"]) == []
+
+
+def test_lock_discipline_suppression_covers_def(tmp_path):
+    src = LOCK_BAD.replace(
+        "    def peek(self):",
+        "    def peek(self):  # edl-lint: disable=lock-discipline -- benign racy read",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["lock-discipline"])
+    assert "unguarded-access" not in {
+        f.check for f in findings if "peek" in f.message
+    }
+
+
+def test_suppression_requires_reason(tmp_path):
+    src = LOCK_BAD.replace(
+        "    def peek(self):",
+        "    def peek(self):  # edl-lint: disable=lock-discipline",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["lock-discipline"])
+    checks = {(f.rule, f.check) for f in findings}
+    # the reasonless suppression is itself a finding AND does not suppress
+    assert ("lint", "suppression-missing-reason") in checks
+    assert ("lock-discipline", "unguarded-access") in checks
+
+
+def test_suppression_unknown_rule_is_flagged(tmp_path):
+    src = LOCK_GOOD.replace(
+        "    def peek(self):",
+        "    def peek(self):  # edl-lint: disable=made-up-rule -- because",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = {(f.rule, f.check) for f in run_analysis(root)}
+    assert ("lint", "unknown-suppressed-rule") in checks
+
+
+# -- jit-purity --------------------------------------------------------------
+
+JIT_BAD = """
+import time
+
+import jax
+
+
+@jax.jit
+def stamped(x):
+    return x + time.time()
+
+
+acc = []
+
+
+def log_step(x):
+    acc.append(x)
+    return x
+
+
+log_jit = jax.jit(log_step)
+"""
+
+JIT_GOOD = """
+import jax
+
+
+@jax.jit
+def double(x):
+    return x * 2
+
+
+def build(tx):
+    def step(params, state, grads):
+        updates, state = tx.update(grads, state, params)
+        scales = {}
+        scales["lr"] = 1.0
+        return params + updates * scales["lr"], state
+
+    return jax.jit(step)
+"""
+
+
+def test_jit_purity_flags_impure_and_captured(tmp_path):
+    root = _tree(tmp_path, {"mod.py": JIT_BAD})
+    checks = _checks(run_analysis(root, rules=["jit-purity"]), "jit-purity")
+    assert "impure-call" in checks  # time.time under trace
+    assert "captured-mutation" in checks  # acc.append from outer scope
+
+
+def test_jit_purity_clean_functional_update(tmp_path):
+    # optax-style consumed .update() and within-trace dict stores are pure
+    root = _tree(tmp_path, {"mod.py": JIT_GOOD})
+    assert run_analysis(root, rules=["jit-purity"]) == []
+
+
+def test_jit_purity_partial_decorator(tmp_path):
+    src = """
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def f(x, n):
+    print(x)
+    return x * n
+"""
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(run_analysis(root, rules=["jit-purity"]), "jit-purity")
+    assert "impure-call" in checks
+
+
+# -- env-registry ------------------------------------------------------------
+
+ENV_GOOD = """
+import os
+
+ENV_FOO = "EDL_FOO"
+ENV_REGISTRY = {ENV_FOO: "a declared knob"}
+
+
+def read():
+    return os.getenv(ENV_FOO, "0")
+"""
+
+ENV_BAD = ENV_GOOD + """
+
+def sneak():
+    return os.environ.get("EDL_SNEAKY")
+"""
+
+
+def test_env_registry_clean(tmp_path):
+    root = _tree(tmp_path, {"mod.py": ENV_GOOD})
+    assert run_analysis(root, rules=["env-registry"]) == []
+
+
+def test_env_registry_flags_undeclared(tmp_path):
+    root = _tree(tmp_path, {"mod.py": ENV_BAD})
+    findings = run_analysis(root, rules=["env-registry"])
+    assert _checks(findings, "env-registry") == {"undeclared-env-var"}
+    assert any("EDL_SNEAKY" in f.message for f in findings)
+
+
+def test_env_registry_no_registry(tmp_path):
+    src = 'import os\n\nV = os.getenv("EDL_ORPHAN")\n'
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(run_analysis(root, rules=["env-registry"]), "env-registry")
+    assert checks == {"no-registry"}
+
+
+def test_env_registry_ignores_unprefixed(tmp_path):
+    src = 'import os\n\nV = os.getenv("PATH")\n'
+    root = _tree(tmp_path, {"mod.py": src})
+    assert run_analysis(root, rules=["env-registry"]) == []
+
+
+# -- core: parse errors, baseline, CLI ---------------------------------------
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    root = _tree(tmp_path, {"broken.py": "def f(:\n"})
+    checks = {(f.rule, f.check) for f in run_analysis(root)}
+    assert ("lint", "parse-error") in checks
+
+
+def test_cli_exit_codes_both_directions(tmp_path):
+    bad = _tree(tmp_path / "bad", {"mod.py": LOCK_BAD})
+    good = _tree(tmp_path / "good", {"mod.py": LOCK_GOOD})
+    assert lint_main(["--root", bad, "--no-baseline"]) == 1
+    assert lint_main(["--root", good, "--no-baseline"]) == 0
+
+
+@pytest.mark.parametrize("rule", RULE_FAMILIES)
+def test_cli_rule_selection(tmp_path, rule):
+    sources = {
+        "rpc-conformance": RPC_BAD_NO_HANDLER,
+        "lock-discipline": LOCK_BAD,
+        "jit-purity": JIT_BAD,
+        "env-registry": ENV_BAD,
+    }
+    root = _tree(tmp_path, {"mod.py": sources[rule]})
+    assert lint_main(["--root", root, "--rule", rule, "--no-baseline"]) == 1
+    others = [r for r in RULE_FAMILIES if r != rule]
+    args = ["--root", root, "--no-baseline"]
+    for r in others:
+        args += ["--rule", r]
+    # ENV_BAD embeds no other family's violation; same for the rest
+    assert lint_main(args) == 0
+
+
+def test_baseline_workflow(tmp_path, capsys):
+    root = _tree(tmp_path, {"mod.py": LOCK_BAD})
+    baseline = str(tmp_path / "baseline.json")
+    # accept the current findings, then the run is clean
+    assert lint_main(["--root", root, "--write-baseline", "--baseline", baseline]) == 0
+    assert lint_main(["--root", root, "--baseline", baseline]) == 0
+    # a NEW finding is not covered by the baseline
+    (tmp_path / "mod2.py").write_text(LOCK_BAD)
+    assert lint_main(["--root", root, "--baseline", baseline]) == 1
+    # fixing everything leaves stale entries: ok, unless --strict-baseline
+    (tmp_path / "mod.py").write_text(LOCK_GOOD)
+    (tmp_path / "mod2.py").write_text(LOCK_GOOD)
+    assert lint_main(["--root", root, "--baseline", baseline]) == 0
+    assert (
+        lint_main(["--root", root, "--baseline", baseline, "--strict-baseline"])
+        == 1
+    )
+    capsys.readouterr()
+
+
+def test_cli_json_format(tmp_path, capsys):
+    root = _tree(tmp_path, {"mod.py": LOCK_BAD})
+    assert lint_main(["--root", root, "--no-baseline", "--format", "json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["new"] and out["baselined"] == 0
+    assert {"rule", "check", "path", "line", "message"} <= set(out["new"][0])
+
+
+def test_baseline_key_is_line_free(tmp_path):
+    root = _tree(tmp_path, {"mod.py": LOCK_BAD})
+    baseline = str(tmp_path / "baseline.json")
+    assert lint_main(["--root", root, "--write-baseline", "--baseline", baseline]) == 0
+    # shifting the findings by a line must not invalidate the baseline
+    (tmp_path / "mod.py").write_text("# a leading comment\n" + LOCK_BAD)
+    assert lint_main(["--root", root, "--baseline", baseline]) == 0
+
+
+# -- the live repo ------------------------------------------------------------
+
+
+def test_repo_is_lint_clean():
+    """The checked-in tree passes with the checked-in baseline; this is
+    the same invocation the CI analysis job runs."""
+    res = subprocess.run(
+        [sys.executable, "-m", "elasticdl_tpu.analysis"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_repo_every_called_method_has_handler():
+    ctx = load_context(PKG_ROOT)
+    handlers = rc._collect_handlers(ctx)
+    called = {s.method for s in rc._collect_call_sites(ctx)}
+    assert called, "call-site collector found nothing — collector broken"
+    assert called <= set(handlers), f"unhandled: {sorted(called - set(handlers))}"
+
+
+def test_repo_policy_sets_match_ast_view():
+    """The AST-collected retry classification IS rpc/policy.py's —
+    proves the lint checks the real policy, not a stale copy."""
+    from elasticdl_tpu.rpc.policy import DEDUP_KEYED_METHODS, IDEMPOTENT_METHODS
+
+    policy = rc._policy_sets(load_context(PKG_ROOT))
+    assert policy["IDEMPOTENT_METHODS"][2] == set(IDEMPOTENT_METHODS)
+    assert policy["DEDUP_KEYED_METHODS"][2] == set(DEDUP_KEYED_METHODS)
+    assert set(DEDUP_KEYED_METHODS) <= set(IDEMPOTENT_METHODS)
+
+
+def test_repo_schemas_cover_handlers_exactly():
+    from elasticdl_tpu.common.messages import WIRE_SCHEMAS
+
+    ctx = load_context(PKG_ROOT)
+    handlers = rc._collect_handlers(ctx)
+    assert set(handlers) == set(WIRE_SCHEMAS)
