@@ -67,6 +67,7 @@ ENV_RPC_RETRIES = "EDL_RPC_RETRIES"
 ENV_RPC_BACKOFF = "EDL_RPC_BACKOFF"
 ENV_RPC_SEED = "EDL_RPC_SEED"
 ENV_SYNC_DEPTH = "EDL_SYNC_DEPTH"
+ENV_OPT_MIRROR_SECS = "EDL_OPT_MIRROR_SECS"
 ENV_BET_PREFETCH = "EDL_BET_PREFETCH"
 ENV_BENCH_MFU = "EDL_BENCH_MFU"
 ENV_WORKER_LOG_DIR = "EDL_WORKER_LOG_DIR"
@@ -97,6 +98,11 @@ ENV_REGISTRY = {
     ENV_SYNC_DEPTH: (
         "max in-flight pipelined window syncs per worker (0 serializes; "
         "default 2)"
+    ),
+    ENV_OPT_MIRROR_SECS: (
+        "recovery plane: seconds between PS optimizer-state mirror "
+        "snapshots (bounded-staleness restore ring, master/recovery.py; "
+        "default 2.0)"
     ),
     ENV_BET_PREFETCH: (
         "0 disables the batched-embedding-training lookup prefetch "

@@ -197,6 +197,7 @@ class EmbeddingUpdateRequest(_WireRequest):
 class PSInitRequest(_WireRequest):
     vec: Any = None
     version: int = 0
+    epoch: int = -1  # fencing epoch; -1 = unfenced (see master/recovery.py)
 
 
 @dataclasses.dataclass
@@ -204,6 +205,7 @@ class PSPullRequest(_WireRequest):
     only_if_newer: bool = False
     version: int = -1
     model_dtype: Optional[str] = None
+    epoch: int = -1
 
 
 @dataclasses.dataclass
@@ -213,6 +215,7 @@ class PSPushGradRequest(_WireRequest):
     return_model: bool = False
     report_key: str = ""
     model_dtype: Optional[str] = None
+    epoch: int = -1
 
 
 @dataclasses.dataclass
@@ -223,22 +226,36 @@ class PSPushDeltaRequest(_WireRequest):
     want_model: bool = False
     report_key: str = ""
     model_dtype: Optional[str] = None
+    epoch: int = -1
 
 
 @dataclasses.dataclass
 class PSOptStateRequest(_WireRequest):
-    pass
+    epoch: int = -1
 
 
 @dataclasses.dataclass
 class PSOptRestoreRequest(_WireRequest):
     leaves: Any = None
+    epoch: int = -1
+
+
+@dataclasses.dataclass
+class PSRestoreFromWorkerRequest(_WireRequest):
+    """A worker's flat-buffer slice offered as the restore source for a
+    relaunched PS shard (master RPC, see master/recovery.py)."""
+
+    worker_id: int = -1
+    shard_id: int = -1
+    vec: Any = None  # the worker's absorbed slice for that shard
+    version: int = -1  # the worker's absorbed version for that shard
 
 
 @dataclasses.dataclass
 class KVLookupRequest(_WireRequest):
     layer: str = ""
     ids: Any = None
+    epoch: int = -1
 
 
 @dataclasses.dataclass
@@ -247,21 +264,49 @@ class KVUpdateRequest(_WireRequest):
     ids: Any = None
     values: Any = None
     set_if_not_exist: bool = False
+    epoch: int = -1
 
 
 @dataclasses.dataclass
 class KVSnapshotRequest(_WireRequest):
-    pass
+    epoch: int = -1
 
 
 @dataclasses.dataclass
 class KVRestoreRequest(_WireRequest):
     layers: Any = None  # {layer: {"ids": [n], "values": [n, dim]}}
+    epoch: int = -1
 
 
 @dataclasses.dataclass
 class KVLenRequest(_WireRequest):
-    pass
+    epoch: int = -1
+
+
+@dataclasses.dataclass
+class KVMirrorRequest(_WireRequest):
+    """Async write mirroring primary -> paired replica shard. The
+    replica keeps mirrored rows per source shard, outside its own
+    primary store; recovery drains them back via KVMirrorSnapshot."""
+
+    source_shard: int = -1
+    layer: str = ""
+    ids: Any = None
+    values: Any = None
+    set_if_not_exist: bool = False
+
+
+@dataclasses.dataclass
+class KVMirrorSnapshotRequest(_WireRequest):
+    source_shard: int = -1
+
+
+@dataclasses.dataclass
+class KVSetMirrorRequest(_WireRequest):
+    """Points a shard at its mirror target (the group wires pairs after
+    endpoints exist; '' disables mirroring)."""
+
+    endpoint: str = ""
 
 
 #: The declared request contract, method name -> wire dataclass. The
@@ -289,11 +334,15 @@ WIRE_SCHEMAS: Dict[str, type] = {
     "PSPushDelta": PSPushDeltaRequest,
     "PSOptState": PSOptStateRequest,
     "PSOptRestore": PSOptRestoreRequest,
+    "PSRestoreFromWorker": PSRestoreFromWorkerRequest,
     "KVLookup": KVLookupRequest,
     "KVUpdate": KVUpdateRequest,
     "KVSnapshot": KVSnapshotRequest,
     "KVRestore": KVRestoreRequest,
     "KVLen": KVLenRequest,
+    "KVMirror": KVMirrorRequest,
+    "KVMirrorSnapshot": KVMirrorSnapshotRequest,
+    "KVSetMirror": KVSetMirrorRequest,
 }
 
 

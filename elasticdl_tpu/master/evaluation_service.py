@@ -69,9 +69,17 @@ class _EvaluationJob:
     def get_metrics(self) -> Dict[str, float]:
         from elasticdl_tpu.api.metrics import finalize_metric_state
 
-        if not self._num_examples:
+        # empty ONLY when nothing at all was reported: the zero-example
+        # guard protects just the scalar division — a states-only job
+        # (every metric mergeable) must still finalize its states
+        if not self._metric_sums and not self._metric_states:
             return {}
-        out = {k: v / self._num_examples for k, v in self._metric_sums.items()}
+        out = {}
+        if self._num_examples:
+            out = {
+                k: v / self._num_examples
+                for k, v in self._metric_sums.items()
+            }
         for name, state in self._metric_states.items():
             out[name] = finalize_metric_state(state)
         return out

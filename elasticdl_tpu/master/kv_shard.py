@@ -19,15 +19,34 @@ its optimizer slots always co-locate on one shard.
 Wire format for snapshot/restore: {layer: (ids[n], values[n, dim])}
 arrays — the nested {id: row} dict form does not survive msgpack's
 string-key maps.
+
+Replica mirroring (the recovery plane's KV restore source, see
+master/recovery.py): each shard asynchronously forwards its applied
+writes to a paired shard (`KVSetMirror` wires the pairs after
+endpoints exist — ring topology, shard i mirrors to (i+1) % N). The
+receiver keeps mirrored rows in a SEPARATE per-source store, outside
+its own primary rows; when shard i dies, the recovery plane drains
+`KVMirrorSnapshot(source_shard=i)` from its pair and `KVRestore`s the
+rows into the relaunched shard. Mirroring is bounded-staleness by
+design: rows enqueued but not yet forwarded at death are lost (they
+re-enter as cold rows), which never affects step accounting.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import queue
+import threading
+from typing import Any, Dict, Optional
 
 import numpy as np
 
+from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.master.embedding_store import EmbeddingStore
+
+logger = get_logger(__name__)
+
+#: mirror-thread shutdown sentinel
+_STOP = object()
 
 
 def snapshot_to_arrays(
@@ -59,10 +78,23 @@ def arrays_to_snapshot(
 class KVShardServicer:
     """One shard's RPC surface over a local EmbeddingStore."""
 
-    def __init__(self, shard_id: int, num_shards: int):
+    def __init__(self, shard_id: int, num_shards: int, generation: int = 0):
         self.shard_id = int(shard_id)
         self.num_shards = int(num_shards)
+        # fencing epoch (see rpc/fencing.py): immutable per servicer, a
+        # relaunch constructs a new one at the bumped generation
+        self.generation = int(generation)
         self._store = EmbeddingStore()
+        # outbound mirroring (this shard as primary)
+        self._mirror_lock = threading.Lock()
+        self._mirror_endpoint: Optional[str] = None
+        self._mirror_q: "queue.Queue" = queue.Queue()
+        self._mirror_thread: Optional[threading.Thread] = None
+        self._mirrored_writes = 0
+        self._mirror_drops = 0
+        # inbound mirrored rows (this shard as someone's replica),
+        # keyed by source shard id — never mixed into the primary store
+        self._mirror_stores: Dict[int, EmbeddingStore] = {}
 
     def handlers(self) -> Dict[str, Any]:
         return {
@@ -71,14 +103,73 @@ class KVShardServicer:
             "KVSnapshot": self.kv_snapshot,
             "KVRestore": self.kv_restore,
             "KVLen": self.kv_len,
+            "KVMirror": self.kv_mirror,
+            "KVMirrorSnapshot": self.kv_mirror_snapshot,
+            "KVSetMirror": self.kv_set_mirror,
         }
 
+    def _check_epoch(self, req: dict):
+        from elasticdl_tpu.rpc.fencing import check_epoch
+
+        check_epoch(req, self.generation, "kv", self.shard_id)
+
     def kv_lookup(self, req: dict) -> dict:
+        self._check_epoch(req)
         values, unknown = self._store.lookup(req["layer"], req["ids"])
         return {"values": values, "unknown_index": unknown}
 
     def kv_update(self, req: dict) -> dict:
+        self._check_epoch(req)
         self._store.update(
+            req["layer"],
+            req["ids"],
+            req["values"],
+            set_if_not_exist=req.get("set_if_not_exist", False),
+        )
+        self._enqueue_mirror(req)
+        return {}
+
+    def kv_snapshot(self, req: dict) -> dict:
+        self._check_epoch(req)
+        return {"layers": snapshot_to_arrays(self._store.snapshot())}
+
+    def kv_restore(self, req: dict) -> dict:
+        self._check_epoch(req)
+        self._store.restore(arrays_to_snapshot(req.get("layers") or {}))
+        return {}
+
+    def kv_len(self, req: dict) -> dict:
+        self._check_epoch(req)
+        return {"n": len(self._store)}
+
+    # -- replica mirroring ---------------------------------------------------
+    # KVMirror / KVMirrorSnapshot / KVSetMirror carry no fencing epoch:
+    # they are shard<->shard / group->shard control traffic addressed by
+    # the group, which always talks to the generation it just launched.
+
+    def kv_set_mirror(self, req: dict) -> dict:
+        """Point this shard at its mirror target ('' disables)."""
+        endpoint = req.get("endpoint") or ""
+        with self._mirror_lock:
+            self._mirror_endpoint = endpoint or None
+            if endpoint and self._mirror_thread is None:
+                self._mirror_thread = threading.Thread(
+                    target=self._mirror_loop,
+                    name=f"kv{self.shard_id}-mirror",
+                    daemon=True,
+                )
+                self._mirror_thread.start()
+        return {}
+
+    def kv_mirror(self, req: dict) -> dict:
+        """Receive a primary's forwarded write into the per-source
+        mirror store (LWW, same semantics as KVUpdate)."""
+        source = int(req.get("source_shard", -1))
+        with self._mirror_lock:
+            store = self._mirror_stores.get(source)
+            if store is None:
+                store = self._mirror_stores[source] = EmbeddingStore()
+        store.update(
             req["layer"],
             req["ids"],
             req["values"],
@@ -86,12 +177,89 @@ class KVShardServicer:
         )
         return {}
 
-    def kv_snapshot(self, req: dict) -> dict:
-        return {"layers": snapshot_to_arrays(self._store.snapshot())}
+    def kv_mirror_snapshot(self, req: dict) -> dict:
+        """Everything this shard holds on behalf of `source_shard` —
+        the recovery plane's restore payload for that shard."""
+        source = int(req.get("source_shard", -1))
+        with self._mirror_lock:
+            store = self._mirror_stores.get(source)
+        layers = snapshot_to_arrays(store.snapshot()) if store else {}
+        return {"layers": layers}
 
-    def kv_restore(self, req: dict) -> dict:
-        self._store.restore(arrays_to_snapshot(req.get("layers") or {}))
-        return {}
+    def _enqueue_mirror(self, req: dict):
+        with self._mirror_lock:
+            if self._mirror_endpoint is None:
+                return
+        self._mirror_q.put(
+            {
+                "source_shard": self.shard_id,
+                "layer": req["layer"],
+                "ids": req["ids"],
+                "values": req["values"],
+                "set_if_not_exist": req.get("set_if_not_exist", False),
+            }
+        )
 
-    def kv_len(self, req: dict) -> dict:
-        return {"n": len(self._store)}
+    def _mirror_loop(self):
+        """Drain the outbound queue to the paired shard. Best-effort:
+        a write that keeps failing is dropped (bounded staleness), so a
+        slow or dead replica can never stall the primary's write path."""
+        from elasticdl_tpu.rpc.client import RpcClient
+
+        client = None
+        client_endpoint = None
+        while True:
+            item = self._mirror_q.get()
+            if item is _STOP:
+                break
+            with self._mirror_lock:
+                endpoint = self._mirror_endpoint
+            if endpoint is None:
+                continue
+            try:
+                if client is None or client_endpoint != endpoint:
+                    if client is not None:
+                        client.close()
+                    client = RpcClient(endpoint)
+                    client_endpoint = endpoint
+                client.call("KVMirror", item, timeout=10.0)
+                self._mirrored_writes += 1
+            except Exception as e:  # noqa: BLE001 - mirror is best-effort
+                self._mirror_drops += 1
+                logger.warning(
+                    "kv shard %d: mirror write to %s dropped: %s",
+                    self.shard_id, endpoint, e,
+                )
+        if client is not None:
+            client.close()
+
+    def mirror_flush(self, timeout: float = 10.0) -> bool:
+        """Block until the outbound mirror queue drains (tests and the
+        recovery plane's pre-snapshot barrier)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._mirror_q.empty():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def close(self):
+        with self._mirror_lock:
+            thread = self._mirror_thread
+            self._mirror_thread = None
+        if thread is not None:
+            self._mirror_q.put(_STOP)
+            thread.join(timeout=5.0)
+
+    def stats(self) -> Dict[str, int]:
+        with self._mirror_lock:
+            mirror_sources = len(self._mirror_stores)
+        return {
+            "n": len(self._store),
+            "generation": self.generation,
+            "mirrored_writes": self._mirrored_writes,
+            "mirror_drops": self._mirror_drops,
+            "mirror_sources": mirror_sources,
+        }

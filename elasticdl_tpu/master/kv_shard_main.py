@@ -38,6 +38,12 @@ def kv_shard_parser() -> argparse.ArgumentParser:
         help="publish the bound port here (ephemeral-port discovery)",
     )
     p.add_argument("--log_level", default="INFO")
+    p.add_argument(
+        "--generation", type=non_neg_int, default=0,
+        help="fencing epoch of this shard slot (bumped per relaunch; "
+        "requests carrying a different epoch are rejected — "
+        "rpc/fencing.py)",
+    )
     return p
 
 
@@ -67,13 +73,16 @@ def main(argv=None) -> int:
     from elasticdl_tpu.master.kv_shard import KVShardServicer
     from elasticdl_tpu.rpc.server import RpcServer
 
-    servicer = KVShardServicer(args.shard_id, args.num_shards)
+    servicer = KVShardServicer(
+        args.shard_id, args.num_shards, generation=args.generation
+    )
     server = RpcServer(servicer.handlers(), port=args.port)
     server.start()
     logger.info(
-        "KV shard %d/%d listening on :%d",
+        "KV shard %d/%d (generation %d) listening on :%d",
         args.shard_id,
         args.num_shards,
+        args.generation,
         server.port,
     )
     if args.port_file:
@@ -87,6 +96,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda s, f: stop.set())
     stop.wait()
     server.stop()
+    servicer.close()  # join the mirror drain thread
     return 0
 
 

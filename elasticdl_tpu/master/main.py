@@ -198,6 +198,7 @@ def build_master(args, job_type: str, cluster_backend=None):
                 lr_staleness_modulation=args.lr_staleness_modulation,
                 staleness_window=args.staleness_window,
                 k8s_backend=cluster_backend if mode == "k8s" else None,
+                num_workers=args.num_workers,
             )
             ps_group.start()
 
@@ -465,12 +466,27 @@ def main(argv=None) -> int:
                 make_sample_batch_fn(args.training_data_dir)
             )
     ps_dead = threading.Event()
+    recovery = None
     if servicer.ps_group is not None or servicer.kv_group is not None:
-        # PS and KV shards are job-lifetime with no relaunch path: a
-        # dead shard means every future push/pull/lookup fails, so fail
-        # the whole job fast instead of letting the workers crash-loop
-        # (the worker_manager routes terminal events for BOTH replica
-        # types through this hook)
+        # Shard recovery plane (master/recovery.py): a dead PS/KV
+        # shard is fenced, relaunched at a bumped generation, and
+        # restored (worker flat-buffer upload + opt-state mirror for
+        # PS; ring-pair mirror snapshot for KV). The job fails fast
+        # ONLY when a shard is unrecoverable (no restore source before
+        # the deadline) — the pre-recovery behavior, kept as the
+        # degraded rung.
+        from elasticdl_tpu.master.recovery import RecoveryPlane
+
+        recovery = RecoveryPlane(
+            servicer,
+            ps_group=servicer.ps_group,
+            kv_group=servicer.kv_group,
+            on_unrecoverable=lambda kind, sid: ps_dead.set(),
+        )
+        servicer.set_recovery_plane(recovery)
+        recovery.start()
+        manager.on_shard_failure = recovery.on_shard_failure
+        # fallback when the plane is torn down first (see finally)
         manager.on_ps_failure = lambda sid: ps_dead.set()
     manager.start_workers()
     logger.info("Worker manager status: %s", WorkerManagerStatus.RUNNING)
@@ -481,7 +497,9 @@ def main(argv=None) -> int:
         # faster here — process workers finish in seconds under test
         while not dispatcher.finished():
             if ps_dead.is_set():
-                logger.error("a PS/KV shard died: aborting the job")
+                logger.error(
+                    "a PS/KV shard is unrecoverable: aborting the job"
+                )
                 exit_code = 2
                 break
             if manager.all_exited():
@@ -508,7 +526,10 @@ def main(argv=None) -> int:
         logger.info("Worker manager status: %s", WorkerManagerStatus.FINISHED)
         # disarm BEFORE teardown deletes shard pods: their DELETED
         # events are expected here, not a mid-job shard death
+        manager.on_shard_failure = None
         manager.on_ps_failure = None
+        if recovery is not None:
+            recovery.stop()
         manager.stop_relaunch_and_remove_workers()
         ckpt.close()  # queued async checkpoint writes must land
         if eval_service is not None:

@@ -18,12 +18,14 @@ type "ps", created like worker pods — see cluster/k8s_backend.py);
 this manager handles the local modes, which is what the master uses
 when ``--worker_backend process``.
 
-The group is NOT elastic: shards are job-lifetime services, exactly
-like the reference's Redis embedding pods (reference:
-elasticdl/python/master/embedding_service.py:231-268 — spawned at
-master boot, torn down with the job). Elasticity lives in the worker
-fleet; a dead shard is a job failure (the reference's dead-Redis
-story is the same).
+Shards are job-lifetime services like the reference's Redis embedding
+pods (reference: elasticdl/python/master/embedding_service.py:231-268
+— spawned at master boot, torn down with the job), but unlike the
+reference a dead shard is no longer a job failure: the recovery plane
+(master/recovery.py) relaunches the slot via `relaunch_shard` at a
+bumped fencing generation and restores its state from a worker
+flat-buffer upload + the master's opt-state mirror. `poll_dead`
+feeds process-mode shard deaths to that plane.
 """
 
 from __future__ import annotations
@@ -56,6 +58,8 @@ class PSShardGroup:
         staleness_window: int = 0,
         boot_timeout: float = 60.0,
         k8s_backend=None,  # K8sBackend for mode="k8s" (PS pods)
+        num_workers: int = 1,
+        max_inflight_syncs: int = 8,
     ):
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
@@ -77,7 +81,11 @@ class PSShardGroup:
             staleness_window=staleness_window,
         )
         self._boot_timeout = boot_timeout
+        self._dedup_cap = self.dedup_cap_for(num_workers, max_inflight_syncs)
         self.endpoints: List[str] = []
+        # fencing generation per shard SLOT, bumped on every relaunch;
+        # clients stamp these as request epochs (rpc/fencing.py)
+        self.generations: List[int] = [0] * num_shards
         self._servers = []  # inproc RpcServers
         # inproc servicer refs: tests/operators read stats() (e.g. the
         # chaos e2e asserts the dedup ring absorbed retried pushes)
@@ -86,6 +94,22 @@ class PSShardGroup:
         self._k8s_created = 0  # pods created (>= endpoints resolved)
         self._client: Optional[ShardedPS] = None
         self._n_params = -1
+        self._reported_dead = set()  # poll_dead dedup (dead Popen refs)
+
+    @staticmethod
+    def dedup_cap_for(num_workers: int, max_inflight_syncs: int = 8) -> int:
+        """Dedup ring capacity: only keys whose sync is still in flight
+        can legally be resent, so the ring must dominate
+        num_workers x max in-flight syncs per worker (sync depth /
+        step-pipeline depth) — derivation next to the retry
+        classification in rpc/ps_client.py. x4 headroom covers syncs
+        straddling a relaunch; the 512 floor keeps the old default for
+        small jobs."""
+        return max(512, int(num_workers) * int(max_inflight_syncs) * 4)
+
+    @property
+    def num_shards(self) -> int:
+        return self._n
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -108,6 +132,8 @@ class PSShardGroup:
         flags = [
             "--shard_id", str(shard_id),
             "--num_shards", str(self._n),
+            "--generation", str(self.generations[shard_id]),
+            "--dedup_cap", str(self._dedup_cap),
             "--grads_to_wait", str(self._sync_flags["grads_to_wait"]),
             "--staleness_window", str(self._sync_flags["staleness_window"]),
         ] + self._shard_argv
@@ -143,24 +169,33 @@ class PSShardGroup:
                 self._k8s_created = i + 1
 
     def _start_inproc(self):
+        for i in range(self._n):
+            servicer, server = self._build_inproc_shard(i)
+            self.servicers.append(servicer)
+            self._servers.append(server)
+            self.endpoints.append(f"localhost:{server.port}")
+
+    def _build_inproc_shard(self, i: int):
         from elasticdl_tpu.master.ps_optimizer import PSOptimizer
         from elasticdl_tpu.master.ps_shard import PSShardServicer
         from elasticdl_tpu.rpc.server import RpcServer
 
-        for i in range(self._n):
-            opt = (
-                PSOptimizer(self._opt_factory())
-                if self._opt_factory is not None
-                else None
-            )
-            servicer = PSShardServicer(
-                i, self._n, optimizer=opt, **self._sync_flags
-            )
-            server = RpcServer(servicer.handlers(), port=0)
-            server.start()
-            self.servicers.append(servicer)
-            self._servers.append(server)
-            self.endpoints.append(f"localhost:{server.port}")
+        opt = (
+            PSOptimizer(self._opt_factory())
+            if self._opt_factory is not None
+            else None
+        )
+        servicer = PSShardServicer(
+            i,
+            self._n,
+            optimizer=opt,
+            generation=self.generations[i],
+            dedup_cap=self._dedup_cap,
+            **self._sync_flags,
+        )
+        server = RpcServer(servicer.handlers(), port=0)
+        server.start()
+        return servicer, server
 
     def _start_process(self):
         from elasticdl_tpu.master.shard_host import spawn_shard_processes
@@ -172,6 +207,81 @@ class PSShardGroup:
             "edl_ps_",
             self._boot_timeout,
         )
+
+    # -- recovery plane hooks ------------------------------------------------
+
+    def poll_dead(self) -> List[tuple]:
+        """[(shard_id, exit_code)] for process-mode shards that died
+        since the last relaunch. Each dead PROCESS is reported once —
+        keyed by the Popen object, not (shard, generation): relaunch
+        bumps the generation before the replacement process lands in
+        `_procs`, so a generation key would both re-report the old
+        corpse under the new generation (relaunch storm) and consume
+        the new generation's one report (a real second death would
+        then go unseen). The recovery plane (master/recovery.py) polls
+        this because shard subprocesses, unlike workers, have no
+        pod-event stream."""
+        out = []
+        for i, p in enumerate(self._procs):
+            if p is None or p.poll() is None:
+                continue
+            if p in self._reported_dead:
+                continue
+            self._reported_dead.add(p)
+            out.append((i, p.returncode))
+        return out
+
+    def relaunch_shard(self, shard_id: int) -> str:
+        """Relaunch one shard SLOT at a bumped fencing generation.
+        Returns the new endpoint. The relaunched shard boots EMPTY —
+        the caller (recovery plane) restores model/opt state before
+        re-advertising the endpoint to workers."""
+        i = int(shard_id)
+        self.generations[i] += 1
+        if self._mode == "inproc":
+            if self._servers:
+                self._servers[i].stop()
+            servicer, server = self._build_inproc_shard(i)
+            self.servicers[i] = servicer
+            self._servers[i] = server
+            self.endpoints[i] = f"localhost:{server.port}"
+        elif self._mode == "process":
+            from elasticdl_tpu.master.shard_host import (
+                spawn_shard_processes,
+                stop_shard_processes,
+            )
+
+            if self._procs and self._procs[i].poll() is None:
+                stop_shard_processes([self._procs[i]])  # fence a zombie
+            procs, endpoints = spawn_shard_processes(
+                1,
+                "elasticdl_tpu.master.ps_shard_main",
+                self._shard_cli_flags,
+                "edl_ps_",
+                self._boot_timeout,
+                shard_ids=[i],
+            )
+            self._procs[i] = procs[0]
+            self.endpoints[i] = endpoints[0]
+        else:  # k8s
+            self._k8s_backend.delete_ps_shard(i)
+            if hasattr(self._k8s_backend, "create_ps_shard"):
+                self._k8s_backend.create_ps_shard(i, self._shard_cli_flags(i))
+                self.endpoints[i] = self._k8s_backend.wait_ps_shard_ip(
+                    i, timeout=self._boot_timeout * 5
+                )
+            else:
+                self.endpoints[i] = self._k8s_backend.start_ps_shard(
+                    i, self._shard_cli_flags(i)
+                )
+        # the master's own fan-out client must follow the move
+        if self._client is not None:
+            self._client.update_endpoints(self.endpoints, self.generations)
+        logger.info(
+            "PS shard %d relaunched at generation %d on %s",
+            i, self.generations[i], self.endpoints[i],
+        )
+        return self.endpoints[i]
 
     def stop(self):
         if self._client is not None:
@@ -199,7 +309,9 @@ class PSShardGroup:
             if n_params is None:
                 raise RuntimeError("PS group client needs n_params once")
             self._n_params = int(n_params)
-            self._client = ShardedPS(self.endpoints, self._n_params)
+            self._client = ShardedPS(
+                self.endpoints, self._n_params, generations=self.generations
+            )
             self._client.wait_ready(self._boot_timeout)
         return self._client
 

@@ -83,9 +83,16 @@ class PSShardServicer:
         use_async: bool = False,
         lr_staleness_modulation: bool = False,
         staleness_window: int = 0,
+        generation: int = 0,
+        dedup_cap: Optional[int] = None,
     ):
         self.shard_id = shard_id
         self.num_shards = num_shards
+        # fencing epoch: bumped by the group on every relaunch of this
+        # shard slot; immutable for the servicer's lifetime (a relaunch
+        # constructs a NEW servicer). Requests carrying a different
+        # epoch are rejected hard (rpc/fencing.py).
+        self.generation = int(generation)
         self._opt = optimizer
         self._grads_to_wait = grads_to_wait
         self._use_async = use_async
@@ -103,8 +110,15 @@ class PSShardServicer:
         # no-op instead of double-applying — this is what makes the
         # client's transient retry safe for mutating ops and shrinks
         # the torn-report window to hard shard death (ADVICE r3 #2).
+        #
+        # Capacity: the ring only has to remember keys that can still be
+        # retried, i.e. every in-flight sync of every worker — the group
+        # sizes it as num_workers x max in-flight syncs per worker, with
+        # headroom (see PSShardGroup / the bound derivation next to the
+        # retry classification in rpc/ps_client.py). 512 is the
+        # standalone default for direct-constructed servicers.
         self._seen_reports: "OrderedDict[str, None]" = OrderedDict()
-        self._seen_cap = 512
+        self._seen_cap = max(64, int(dedup_cap)) if dedup_cap else 512
         # observability: chaos tests assert the dedup ring actually
         # absorbed retried pushes (a dropped-response retry MUST land
         # here, not double-apply)
@@ -123,8 +137,14 @@ class PSShardServicer:
             "PSOptRestore": self.opt_restore,
         }
 
+    def _check_epoch(self, req: dict):
+        from elasticdl_tpu.rpc.fencing import check_epoch
+
+        check_epoch(req, self.generation, "ps", self.shard_id)
+
     def opt_state(self, req: dict) -> dict:
         """Flat optimizer-state leaves of this slice (exact resume)."""
+        self._check_epoch(req)
         with self._lock:
             leaves = (
                 self._opt.state_snapshot()
@@ -135,6 +155,7 @@ class PSShardServicer:
 
     def opt_restore(self, req: dict) -> dict:
         """Adopt checkpointed optimizer state for this slice."""
+        self._check_epoch(req)
         with self._lock:
             if self._vec is None:
                 raise ValueError("opt restore before slice init")
@@ -157,6 +178,7 @@ class PSShardServicer:
         """SETNX semantics (like the embedding store's set_if_not_exist,
         reference embedding_service.py:315-357): the first initializer
         wins; late/racing initializers get the current version back."""
+        self._check_epoch(req)
         with self._lock:
             if self._vec is None:
                 self._vec = np.asarray(req["vec"], dtype=np.float32).copy()
@@ -171,6 +193,7 @@ class PSShardServicer:
             return {"version": self._version, "size": self._vec.size}
 
     def pull(self, req: dict) -> dict:
+        self._check_epoch(req)
         with self._lock:
             if self._vec is None:
                 return {"version": -1, "vec": None}
@@ -186,6 +209,7 @@ class PSShardServicer:
         `grads_to_wait` reports within the staleness window. Strict
         equality rejection is refused at configuration time (module
         docstring) so an accept can never be torn across shards."""
+        self._check_epoch(req)
         grad = np.asarray(req["grad"], dtype=np.float32)
         report_version = int(req.get("version", -1))
         with self._lock:
@@ -223,6 +247,7 @@ class PSShardServicer:
                     self._apply(self._grad_sum / self._grad_n)
                     self._grad_sum = None
                     self._grad_n = 0
+            self._record_applied(req)
             resp = {"accepted": True, "version": self._version}
             if req.get("return_model") and self._version != report_version:
                 resp["vec"] = self._wire_vec(req)
@@ -233,6 +258,7 @@ class PSShardServicer:
         MasterServicer.report_local_update: add, advance version by
         `steps`, hand the merged slice back when the pusher's base fell
         behind (another worker synced in between)."""
+        self._check_epoch(req)
         steps = int(req["steps"])
         base_version = int(req["base_version"])
         with self._lock:
@@ -258,6 +284,7 @@ class PSShardServicer:
                     scale = self._staleness_window / float(staleness)
             self._vec += scale * delta if scale != 1.0 else delta
             self._version += steps
+            self._record_applied(req)
             resp = {"version": self._version}
             if base_version + steps != self._version or req.get("want_model"):
                 resp["vec"] = self._wire_vec(req)
@@ -275,23 +302,34 @@ class PSShardServicer:
                 "applied_pushes": self._applied_pushes,
                 "duplicate_pushes": self._duplicate_pushes,
                 "version": self._version,
+                "generation": self.generation,
             }
 
-    def _is_duplicate(self, req: dict) -> bool:
-        """Record req's report_key; True if it was already applied
-        (caller holds the lock). Keyless pushes are never deduped."""
+    def _is_duplicate(self, req: dict) -> bool:  # edl-lint: disable=lock-discipline -- caller holds self._lock
+        """True if req's report_key was already APPLIED (caller holds
+        the lock). Pure membership check: the key is registered by
+        `_record_applied` only after the mutation succeeds (ADVICE r5 —
+        registering before validation meant a push that FAILED mid-apply
+        was answered as an applied duplicate on retry, silently losing
+        the report). Keyless pushes are never deduped."""
         key = req.get("report_key")
-        if not key:
-            self._applied_pushes += 1
-            return False
-        if key in self._seen_reports:
+        if key and key in self._seen_reports:
             self._duplicate_pushes += 1
             return True
+        return False
+
+    def _record_applied(self, req: dict):  # edl-lint: disable=lock-discipline -- caller holds self._lock
+        """Register req's report_key AFTER its mutation succeeded
+        (caller holds the lock). A validation/apply exception unwinds
+        before reaching here, so the key stays unregistered and the
+        client's retry gets a real second attempt."""
+        self._applied_pushes += 1
+        key = req.get("report_key")
+        if not key:
+            return
         self._seen_reports[key] = None
         while len(self._seen_reports) > self._seen_cap:
             self._seen_reports.popitem(last=False)
-        self._applied_pushes += 1
-        return False
 
     def _wire_vec(self, req: dict) -> np.ndarray:  # edl-lint: disable=lock-discipline -- caller holds self._lock
         dtype = req.get("model_dtype")

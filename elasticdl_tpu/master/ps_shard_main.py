@@ -48,6 +48,17 @@ def ps_shard_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_async", action="store_true")
     p.add_argument("--lr_staleness_modulation", action="store_true")
     p.add_argument("--staleness_window", type=non_neg_int, default=0)
+    p.add_argument(
+        "--generation", type=non_neg_int, default=0,
+        help="fencing epoch of this shard slot (bumped per relaunch; "
+        "requests carrying a different epoch are rejected — "
+        "rpc/fencing.py)",
+    )
+    p.add_argument(
+        "--dedup_cap", type=non_neg_int, default=0,
+        help="push dedup ring capacity (0 = servicer default; the "
+        "group sizes it as num_workers x max in-flight syncs)",
+    )
     return p
 
 
@@ -92,13 +103,16 @@ def main(argv=None) -> int:
         use_async=args.use_async,
         lr_staleness_modulation=args.lr_staleness_modulation,
         staleness_window=args.staleness_window,
+        generation=args.generation,
+        dedup_cap=args.dedup_cap or None,
     )
     server = RpcServer(servicer.handlers(), port=args.port)
     server.start()
     logger.info(
-        "PS shard %d/%d listening on :%d",
+        "PS shard %d/%d (generation %d) listening on :%d",
         args.shard_id,
         args.num_shards,
+        args.generation,
         server.port,
     )
     if args.port_file:

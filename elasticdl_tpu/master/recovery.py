@@ -1,0 +1,477 @@
+"""Shard recovery plane: live PS/KV shard failover with exact resume.
+
+The fault-model ladder (docs/fault_model.md) previously ended at rung
+6: a dead PS or KV shard fired `on_ps_failure` and the whole job
+aborted — the shards were the one job-lifetime component with no
+relaunch path. This plane turns that rung into a recovery rung: a dead
+shard is detected, fenced, relaunched at a bumped generation, restored
+from redundant state the plane maintained while the shard was healthy,
+and the job resumes with no master restart.
+
+Per-shard recovery state machine::
+
+    ACTIVE --(death observed)--> FENCED --(relaunch)--> RESTORING
+      ^                                                     |
+      +------------------(state restored)-------------------+
+
+Detection feeds in from two sides: `poll_dead()` on the shard groups
+(process-mode subprocesses have no pod-event stream) and
+`on_shard_failure` (the WorkerManager routes terminal ps/kv pod events
+here when the plane is armed). Both paths dedupe per (kind, shard,
+generation), so a death is recovered exactly once.
+
+Fencing: `relaunch_shard` bumps the slot's generation BEFORE the new
+servicer exists, and every client stamps its requests with the
+generation it knows (rpc/fencing.py). An in-flight push against the
+dead generation therefore fails fast — either UNAVAILABLE (endpoint
+gone) or FAILED_PRECONDITION (zombie/new servicer rejects the stale
+epoch), both deliberately non-retryable at the RPC layer — and the
+worker's outage handler requeues the covered work through the
+existing rungs 1-3 (task recovery), never double-applying.
+
+Restore sources, per plane:
+
+- **PS params** (exact): workers keep a host-side restore snapshot —
+  the last full flat vector a shard fan-out handed back, with its
+  per-shard version vector. During recovery the master advertises the
+  fenced shards via GetPSConfig; each polling worker uploads its
+  snapshot's slice through `PSRestoreFromWorker`. The plane fences the
+  restore at the per-shard version floor it mirrored from
+  ReportWindowMeta reports (every *acked* push is covered by some
+  worker's snapshot at >= that floor) and seeds the relaunched shard
+  with the HIGHEST-version upload via PSInit. Version accounting —
+  the job's step count — is thereby exact: acked applies are restored
+  verbatim, and unacked in-flight pushes failed to their workers, who
+  re-train those steps via task requeue.
+- **PS optimizer state** (bounded staleness): a mirror thread
+  periodically exports each shard's optimizer-state leaves
+  (PSOptState) into a small per-shard snapshot ring; the newest entry
+  is pushed into the relaunched shard via PSOptRestore. Moments lag by
+  at most the mirror cadence (EDL_OPT_MIRROR_SECS) — they shape values,
+  never versions.
+- **KV rows** (bounded staleness): each KV shard asynchronously
+  mirrors its applied writes to its ring pair ((i+1) % N, wired by
+  `wire_mirrors`); recovery drains `KVMirrorSnapshot(source_shard=i)`
+  from the pair and `KVRestore`s it into the relaunched shard. Rows
+  enqueued but not yet forwarded at death re-enter as cold rows
+  (lazy re-init) — row values are approximate, step accounting is
+  untouched.
+
+If no worker can produce a restore upload before the deadline the
+plane declares the shard unrecoverable and fires `on_unrecoverable`,
+which the master wires to the old fail-fast abort — the ladder
+degrades to the previous rung instead of hanging.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.constants import ENV_OPT_MIRROR_SECS
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+# per-shard states (status()/tests read these)
+ACTIVE = "ACTIVE"
+FENCED = "FENCED"
+RELAUNCHING = "RELAUNCHING"
+RESTORING = "RESTORING"
+
+
+class RecoveryPlane:
+    """Master-side controller for PS/KV shard failover."""
+
+    def __init__(
+        self,
+        servicer,
+        ps_group=None,
+        kv_group=None,
+        poll_interval: float = 0.25,
+        opt_mirror_interval: Optional[float] = None,
+        opt_mirror_ring: int = 4,
+        restore_deadline: float = 60.0,
+        on_unrecoverable: Optional[Callable[[str, int], None]] = None,
+    ):
+        self._servicer = servicer
+        self._ps_group = ps_group
+        self._kv_group = kv_group
+        self._poll_interval = poll_interval
+        if opt_mirror_interval is None:
+            import os
+
+            try:
+                opt_mirror_interval = float(
+                    os.environ.get(ENV_OPT_MIRROR_SECS, "2.0").strip()
+                )
+            except ValueError:
+                opt_mirror_interval = 2.0
+        self._opt_mirror_interval = opt_mirror_interval
+        self._opt_mirror_ring = max(1, int(opt_mirror_ring))
+        self._restore_deadline = restore_deadline
+        self._on_unrecoverable = on_unrecoverable
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._states: Dict[tuple, str] = {}  # (kind, shard_id) -> state
+        self._recovering: Dict[str, set] = {"ps": set(), "kv": set()}
+        # shard_id -> (version, vec): best restore candidate so far
+        self._uploads: Dict[int, tuple] = {}
+        # shard_id -> deque of optimizer-state leaves (newest last)
+        self._opt_rings: Dict[int, deque] = {}
+        self._handled: set = set()  # (kind, shard, generation) dedupe
+        self._recoveries: List[tuple] = []  # completed (kind, shard, gen)
+        self._unrecoverable: List[tuple] = []
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._workers: List[threading.Thread] = []  # per-recovery threads
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Arm the plane: wire KV replica mirrors, start the death
+        monitor and the PS opt-state mirror."""
+        if self._started:
+            return
+        self._started = True
+        if self._kv_group is not None:
+            try:
+                self._kv_group.wire_mirrors()
+            except Exception:
+                logger.exception(
+                    "KV mirror wiring failed — KV restore degraded to "
+                    "empty relaunch"
+                )
+        t = threading.Thread(
+            target=self._monitor_loop, name="recovery-monitor", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        if self._ps_group is not None:
+            t = threading.Thread(
+                target=self._opt_mirror_loop,
+                name="recovery-opt-mirror",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        for t in list(self._workers):
+            t.join(timeout=5.0)
+        self._threads = []
+        self._workers = []
+
+    # -- status / servicer hooks ---------------------------------------------
+
+    def status(self) -> Dict[str, List[int]]:
+        """Fenced-shard sets, advertised to workers via GetPSConfig:
+        a worker that sees its shard listed uploads its restore
+        snapshot and holds off re-resolution until the set clears."""
+        with self._lock:
+            return {
+                "ps": sorted(self._recovering["ps"]),
+                "kv": sorted(self._recovering["kv"]),
+            }
+
+    def states(self) -> Dict[tuple, str]:
+        with self._lock:
+            return dict(self._states)
+
+    def recoveries(self) -> List[tuple]:
+        """Completed (kind, shard_id, new_generation) log."""
+        with self._lock:
+            return list(self._recoveries)
+
+    def offer_upload(  # edl-lint: disable=lock-discipline -- self._cv wraps self._lock
+        self, worker_id: int, shard_id: int, vec: Any, version: int
+    ) -> bool:
+        """A worker's restore candidate for a fenced PS shard. Keeps
+        only the highest-version candidate per shard (idempotent: a
+        resend of the same version overwrites an identical payload).
+        Rejected when the shard is not being recovered — late uploads
+        after restore must not clobber a live shard's lineage."""
+        shard_id = int(shard_id)
+        version = int(version)
+        with self._cv:
+            if shard_id not in self._recovering["ps"]:
+                return False
+            cur = self._uploads.get(shard_id)
+            if cur is None or version > cur[0]:
+                self._uploads[shard_id] = (
+                    version,
+                    np.asarray(vec, dtype=np.float32).copy(),
+                )
+                logger.info(
+                    "recovery: worker %s offered PS shard %d restore "
+                    "at v%d",
+                    worker_id, shard_id, version,
+                )
+                self._cv.notify_all()
+            return True
+
+    def on_shard_failure(self, kind: str, shard_id: int):
+        """Pod-event entry point (WorkerManager routes terminal ps/kv
+        pod phases here when the plane is armed)."""
+        self._begin(kind, int(shard_id), "pod event")
+
+    # -- detection -----------------------------------------------------------
+
+    def _monitor_loop(self):
+        while not self._stop.wait(self._poll_interval):
+            try:
+                if self._ps_group is not None:
+                    for i, rc in self._ps_group.poll_dead():
+                        self._begin("ps", i, f"process exit rc={rc}")
+                if self._kv_group is not None:
+                    for i, rc in self._kv_group.poll_dead():
+                        self._begin("kv", i, f"process exit rc={rc}")
+            except Exception:
+                logger.exception("recovery monitor poll failed")
+
+    def _begin(self, kind: str, shard_id: int, why: str):
+        group = self._ps_group if kind == "ps" else self._kv_group
+        if group is None:
+            return
+        with self._lock:
+            if shard_id in self._recovering[kind]:
+                # a recovery is already in flight for this slot — a
+                # repeated pod event (or a poll racing the relaunch
+                # window, where the generation has already moved) must
+                # not stack a second one
+                return
+            key = (kind, shard_id, group.generations[shard_id])
+            if key in self._handled:
+                return  # pod event + poll raced: recover once
+            self._handled.add(key)
+            self._states[(kind, shard_id)] = FENCED
+            self._recovering[kind].add(shard_id)
+            if kind == "ps":
+                self._uploads.pop(shard_id, None)
+        logger.error(
+            "%s shard %d died (%s): starting recovery", kind.upper(),
+            shard_id, why,
+        )
+        t = threading.Thread(
+            target=self._recover,
+            args=(kind, shard_id),
+            name=f"recover-{kind}{shard_id}",
+            daemon=True,
+        )
+        t.start()
+        self._workers.append(t)
+
+    # -- recovery ------------------------------------------------------------
+
+    def _recover(self, kind: str, shard_id: int):
+        try:
+            if kind == "ps":
+                self._recover_ps(shard_id)
+            else:
+                self._recover_kv(shard_id)
+        except Exception:
+            logger.exception(
+                "%s shard %d recovery failed", kind.upper(), shard_id
+            )
+            self._give_up(kind, shard_id)
+
+    def _finish(self, kind: str, shard_id: int, generation: int):  # edl-lint: disable=lock-discipline -- self._cv wraps self._lock
+        with self._cv:
+            self._states[(kind, shard_id)] = ACTIVE
+            self._recovering[kind].discard(shard_id)
+            if kind == "ps":
+                self._uploads.pop(shard_id, None)
+            self._recoveries.append((kind, shard_id, generation))
+            self._cv.notify_all()
+        logger.info(
+            "%s shard %d recovered at generation %d", kind.upper(),
+            shard_id, generation,
+        )
+
+    def _give_up(self, kind: str, shard_id: int):
+        with self._cv:
+            self._recovering[kind].discard(shard_id)
+            self._unrecoverable.append((kind, shard_id))
+            self._cv.notify_all()
+        logger.error(
+            "%s shard %d is UNRECOVERABLE — degrading to fail-fast",
+            kind.upper(), shard_id,
+        )
+        if self._on_unrecoverable is not None:
+            self._on_unrecoverable(kind, shard_id)
+
+    def _recover_ps(self, shard_id: int):  # edl-lint: disable=lock-discipline -- self._cv wraps self._lock
+        from elasticdl_tpu.rpc.client import RpcClient
+
+        group = self._ps_group
+        # the restore floor: the highest version the master has SEEN
+        # this shard ack (per-shard elementwise-max mirror fed by
+        # ReportWindowMeta). Any acked apply at or below it is covered
+        # by the acked worker's snapshot, so an upload >= floor restores
+        # the exact step accounting.
+        fence_version = -1
+        floor_fn = getattr(self._servicer, "shard_version_floor", None)
+        if floor_fn is not None:
+            fence_version = floor_fn(shard_id)
+        with self._lock:
+            self._states[("ps", shard_id)] = RELAUNCHING
+        endpoint = group.relaunch_shard(shard_id)
+        generation = group.generations[shard_id]
+        with self._lock:
+            self._states[("ps", shard_id)] = RESTORING
+
+        # wait for a worker upload that reaches the fence; past the
+        # deadline fall back to the best available (resume stays
+        # correct, just not version-exact), and with NO upload at all
+        # the shard is unrecoverable.
+        deadline = time.monotonic() + self._restore_deadline
+        best = None
+        with self._cv:
+            while not self._stop.is_set():
+                best = self._uploads.get(shard_id)
+                if best is not None and best[0] >= fence_version:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(min(0.25, remaining))
+            best = self._uploads.get(shard_id)
+        if best is None:
+            self._give_up("ps", shard_id)
+            return
+        version, vec = best
+        if version < fence_version:
+            logger.warning(
+                "PS shard %d: best restore upload v%d < fence v%d — "
+                "resuming from it anyway (resume is not version-exact)",
+                shard_id, version, fence_version,
+            )
+        client = RpcClient(endpoint)
+        try:
+            client.call(
+                "PSInit",
+                {"vec": vec, "version": version, "epoch": generation},
+                timeout=60.0,
+            )
+            leaves = None
+            with self._lock:
+                ring = self._opt_rings.get(shard_id)
+                if ring:
+                    leaves = ring[-1]
+            if leaves is not None:
+                client.call(
+                    "PSOptRestore",
+                    {"leaves": leaves, "epoch": generation},
+                    timeout=60.0,
+                )
+            else:
+                logger.warning(
+                    "PS shard %d: no mirrored optimizer state — "
+                    "moments restart cold", shard_id,
+                )
+        finally:
+            client.close()
+        self._finish("ps", shard_id, generation)
+
+    def _recover_kv(self, shard_id: int):
+        from elasticdl_tpu.rpc.client import RpcClient
+
+        group = self._kv_group
+        layers = {}
+        if group.num_shards > 1:
+            pair = group.mirror_pair_of(shard_id)
+            # inproc pairs expose the servicer: drain the outbound
+            # queue of the pair so ITS mirrored view is current (the
+            # dead shard's own unsent queue is lost by design)
+            if getattr(group, "servicers", None):
+                try:
+                    group.servicers[pair].mirror_flush(timeout=5.0)
+                except Exception:
+                    pass
+            pair_client = RpcClient(group.endpoints[pair])
+            try:
+                layers = pair_client.call(
+                    "KVMirrorSnapshot",
+                    {"source_shard": shard_id},
+                    timeout=60.0,
+                ).get("layers") or {}
+            finally:
+                pair_client.close()
+        else:
+            logger.warning(
+                "KV shard %d has no ring pair (num_shards=1): "
+                "relaunching EMPTY — rows re-enter cold", shard_id,
+            )
+        with self._lock:
+            self._states[("kv", shard_id)] = RELAUNCHING
+        endpoint = group.relaunch_shard(shard_id)
+        generation = group.generations[shard_id]
+        with self._lock:
+            self._states[("kv", shard_id)] = RESTORING
+        if layers:
+            client = RpcClient(endpoint)
+            try:
+                client.call(
+                    "KVRestore",
+                    {"layers": layers, "epoch": generation},
+                    timeout=60.0,
+                )
+            finally:
+                client.close()
+        # re-point the ring at the relaunched endpoint (idempotent)
+        if group.num_shards > 1:
+            group.wire_mirrors()
+        self._finish("kv", shard_id, generation)
+
+    @property
+    def num_kv_shards(self) -> int:  # pragma: no cover - convenience
+        return self._kv_group.num_shards if self._kv_group else 0
+
+    # -- PS optimizer-state mirror -------------------------------------------
+
+    def _opt_mirror_loop(self):
+        """Bounded-staleness snapshot ring of each PS shard's optimizer
+        leaves. Best-effort: a failed export (shard mid-relaunch, slow
+        apply) just skips a beat — the ring keeps the newest success."""
+        group = self._ps_group
+        while not self._stop.wait(self._opt_mirror_interval):
+            if not getattr(group, "initialized", False):
+                continue
+            try:
+                client = group.client()
+            except Exception:
+                continue
+            for i in range(len(group.endpoints)):
+                with self._lock:
+                    if i in self._recovering["ps"]:
+                        continue
+                try:
+                    leaves = client.export_opt_shard(i)
+                except Exception:
+                    continue
+                if leaves is None:
+                    continue
+                with self._lock:
+                    ring = self._opt_rings.get(i)
+                    if ring is None:
+                        ring = self._opt_rings[i] = deque(
+                            maxlen=self._opt_mirror_ring
+                        )
+                    ring.append(leaves)
+
+    def opt_ring_depth(self, shard_id: int) -> int:
+        """Mirror-ring occupancy for one shard (tests/observability)."""
+        with self._lock:
+            ring = self._opt_rings.get(int(shard_id))
+            return len(ring) if ring else 0

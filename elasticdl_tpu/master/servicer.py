@@ -26,6 +26,7 @@ churn-induced retry storms don't sink throughput (SURVEY §7.3 item 2).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -40,6 +41,21 @@ from elasticdl_tpu.master.ps_optimizer import PSOptimizer
 from elasticdl_tpu.master.sparse_optimizer import SparseOptimizer
 
 logger = get_logger(__name__)
+
+
+def _is_shard_outage_exc(exc) -> bool:
+    """Walk the cause chain looking for a shard-outage signature
+    (rpc/fencing.is_shard_outage) — store wrappers re-raise RPC errors
+    under their own types, so the grpc error may sit a few links deep."""
+    from elasticdl_tpu.rpc.fencing import is_shard_outage
+
+    hops = 0
+    while exc is not None and hops < 8:
+        if is_shard_outage(exc):
+            return True
+        exc = exc.__cause__ or exc.__context__
+        hops += 1
+    return False
 
 
 def _to_f32(tree):
@@ -112,6 +128,12 @@ class MasterServicer:
         self._pending_aux: Any = None
         self._grad_n = 0
         self._edl_grads: Dict[str, list] = {}
+        # sharded mode: per-PS-shard elementwise-MAX of every version
+        # vector reported via ReportWindowMeta — the recovery plane's
+        # restore fence (the highest version each shard ever acked; any
+        # acked apply is covered by some worker snapshot at >= it)
+        self._shard_version_max: Optional[list] = None
+        self._recovery_plane = None
 
     # -- handler table (the 6 reference RPCs + embedding plane) -------------
 
@@ -130,6 +152,7 @@ class MasterServicer:
             "ReportWindowMeta": self.report_window_meta,
             "GetAux": self.get_aux,
             "GetSampleBatch": self.get_sample_batch,
+            "PSRestoreFromWorker": self.ps_restore_from_worker,
         }
 
     def set_standby_fn(self, fn):
@@ -520,10 +543,32 @@ class MasterServicer:
     def get_ps_config(self, req: dict) -> dict:
         """Shard-endpoint discovery for (re)joining workers — a
         relaunched worker must not depend on argv staying current.
-        Covers BOTH planes: dense PS shards and embedding KV shards."""
+        Covers BOTH planes: dense PS shards and embedding KV shards.
+        Also the recovery plane's worker-facing status word: the
+        ``recovering`` sets tell a worker which shards are fenced (so
+        it should offer its restore snapshot via PSRestoreFromWorker
+        and hold off re-resolving until the sets clear), and the
+        generation lists let it stamp correct fencing epochs after a
+        relaunch."""
         kv = self._kv_group.endpoints if self._kv_group is not None else []
+        kv_gens = (
+            list(self._kv_group.generations)
+            if self._kv_group is not None
+            else []
+        )
+        plane = self._recovery_plane
+        recovering = (
+            plane.status() if plane is not None else {"ps": [], "kv": []}
+        )
         if self._ps_group is None:
-            return {"endpoints": [], "n_params": -1, "kv_endpoints": kv}
+            return {
+                "endpoints": [],
+                "n_params": -1,
+                "kv_endpoints": kv,
+                "ps_generations": [],
+                "kv_generations": kv_gens,
+                "recovering": recovering,
+            }
         with self._lock:
             n = (
                 sum(
@@ -537,6 +582,46 @@ class MasterServicer:
             "endpoints": self._ps_group.endpoints,
             "n_params": n,
             "kv_endpoints": kv,
+            "ps_generations": list(self._ps_group.generations),
+            "kv_generations": kv_gens,
+            "recovering": recovering,
+        }
+
+    # -- recovery plane ------------------------------------------------------
+
+    def set_recovery_plane(self, plane):
+        """Attach the RecoveryPlane (master/recovery.py): GetPSConfig
+        starts advertising its fenced-shard status and
+        PSRestoreFromWorker uploads route to it."""
+        self._recovery_plane = plane
+
+    def shard_version_floor(self, shard_id: int) -> int:
+        """Highest version this PS shard was ever reported to have
+        acked — the recovery plane's restore fence. -1 before any
+        report (restore-from-anything is then acceptable)."""
+        with self._lock:
+            vm = self._shard_version_max
+            i = int(shard_id)
+            if vm is None or i >= len(vm):
+                return -1
+            return vm[i]
+
+    def ps_restore_from_worker(self, req: dict) -> dict:
+        """A worker's restore snapshot slice for a fenced PS shard.
+        Idempotent: the plane keeps only the highest-version candidate
+        per shard, so resends are absorbed. `accepted` is False when
+        the shard is not recovering (late upload) or no plane is
+        attached — the worker just drops its snapshot."""
+        plane = self._recovery_plane
+        if plane is None:
+            return {"accepted": False}
+        return {
+            "accepted": plane.offer_upload(
+                int(req.get("worker_id", -1)),
+                int(req["shard_id"]),
+                req["vec"],
+                int(req["version"]),
+            )
         }
 
     def get_aux(self, req: dict) -> dict:
@@ -565,6 +650,15 @@ class MasterServicer:
             advanced = version > prev
             if advanced:
                 self._version = version
+            if versions:
+                # per-shard max mirror: the recovery plane's restore
+                # fence (shard_version_floor)
+                vm = self._shard_version_max
+                if vm is None or len(vm) != len(versions):
+                    vm = self._shard_version_max = [-1] * len(versions)
+                for i, v in enumerate(versions):
+                    if int(v) > vm[i]:
+                        vm[i] = int(v)
             if req.get("aux_state") is not None:
                 self._aux = req["aux_state"]
             if req.get("want_aux"):
@@ -615,12 +709,53 @@ class MasterServicer:
             vec = vec.astype(codec.dtype_from_str(model_dtype))
         return vec
 
-    def _apply_sparse(self, edl_grads):
+    def _apply_sparse(self, edl_grads):  # edl-lint: disable=lock-discipline -- ride-through deliberately blocks: no sparse apply can proceed mid-recovery
         """Apply IndexedRows to the (possibly RPC-backed) store —
-        callers invoke AFTER releasing self._lock, BEFORE returning."""
-        if edl_grads and self._sparse_opt is not None:
-            with self._sparse_lock:
+        callers invoke AFTER releasing self._lock, BEFORE returning.
+
+        KV-outage ride-through: with a recovery plane armed, a shard
+        death mid-apply must NOT fail the worker's report — the dense
+        slices for this step already applied on the PS shards, so
+        failing here would requeue the task and double-apply them. We
+        block (under _sparse_lock — queueing later reports behind the
+        outage is exactly right) until the plane finishes the KV
+        recovery, then retry. The retried rows are read-modify-write
+        over the restored (bounded-staleness) replica, which is the
+        same staleness contract the mirror itself provides."""
+        if not edl_grads or self._sparse_opt is None:
+            return
+        with self._sparse_lock:
+            try:
                 self._sparse_opt.apply_gradients(edl_grads)
+                return
+            except Exception as exc:
+                if self._recovery_plane is None or not _is_shard_outage_exc(
+                    exc
+                ):
+                    raise
+                logger.warning(
+                    "sparse apply hit a KV shard outage; riding through "
+                    "recovery: %s",
+                    exc,
+                )
+            deadline = time.monotonic() + 90.0
+            while True:
+                time.sleep(0.5)
+                if self._recovery_plane.status().get("kv"):
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            "KV recovery did not complete within the "
+                            "sparse-apply ride-through deadline"
+                        )
+                    continue
+                try:
+                    self._sparse_opt.apply_gradients(edl_grads)
+                    return
+                except Exception as exc:
+                    if time.monotonic() > deadline or not _is_shard_outage_exc(
+                        exc
+                    ):
+                        raise
 
     def _validate(self, grads):  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """Shape sanity checks (reference: servicer.py:320-370)."""

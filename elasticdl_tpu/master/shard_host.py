@@ -24,16 +24,22 @@ def spawn_shard_processes(
     flags_fn: Callable[[int], List[str]],
     prefix: str,
     boot_timeout: float,
+    shard_ids: List[int] = None,
 ) -> Tuple[List[subprocess.Popen], List[str]]:
     """Boot N shard subprocesses of `entry_module`; each binds an
     ephemeral port and publishes it through --port_file (no bind
     races). Returns (procs, endpoints). A boot failure reaps every
     already-spawned process BEFORE raising — the caller's procs list
-    is only assigned on success, so its stop() could never see them."""
+    is only assigned on success, so its stop() could never see them.
+
+    `shard_ids` overrides the identity passed to `flags_fn` and the
+    chaos target stamp — the recovery plane relaunches ONE shard slot
+    (e.g. shard_ids=[2]) while the default boot covers range(n)."""
+    ids = list(shard_ids) if shard_ids is not None else list(range(n))
     tmp = tempfile.mkdtemp(prefix=prefix)
     procs: List[subprocess.Popen] = []
     port_files = []
-    for i in range(n):
+    for i in ids:
         port_file = os.path.join(tmp, f"shard-{i}.port")
         port_files.append(port_file)
         argv = [
@@ -66,16 +72,16 @@ def spawn_shard_processes(
     endpoints = []
     deadline = time.time() + boot_timeout
     try:
-        for i, pf in enumerate(port_files):
+        for k, pf in enumerate(port_files):
             while not os.path.exists(pf):
-                if procs[i].poll() is not None:
+                if procs[k].poll() is not None:
                     raise RuntimeError(
-                        f"shard {i} ({entry_module}) exited "
-                        f"rc={procs[i].returncode} before publishing its port"
+                        f"shard {ids[k]} ({entry_module}) exited "
+                        f"rc={procs[k].returncode} before publishing its port"
                     )
                 if time.time() > deadline:
                     raise TimeoutError(
-                        f"shard {i} ({entry_module}) did not publish a port"
+                        f"shard {ids[k]} ({entry_module}) did not publish a port"
                     )
                 time.sleep(0.05)
             with open(pf) as f:

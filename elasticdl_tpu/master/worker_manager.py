@@ -69,10 +69,15 @@ class WorkerManager:
         self._phases: Dict[int, str] = {}
         self._standby: set = set()  # worker ids held in reserve
         self._live = 0
-        # fired when a PS shard pod dies (shards are job-lifetime with
-        # no relaunch machinery — the job must fail fast, not let every
-        # worker crash-loop against a dead endpoint)
+        # fired when a PS/KV shard pod dies and no recovery plane is
+        # armed (the job must fail fast, not let every worker
+        # crash-loop against a dead endpoint)
         self.on_ps_failure: Optional[Callable[[int], None]] = None
+        # recovery plane hook (master/recovery.py): fn(kind, shard_id)
+        # with kind in ("ps", "kv"). When set it takes precedence over
+        # on_ps_failure — a dead shard is relaunched + restored instead
+        # of failing the job.
+        self.on_shard_failure: Optional[Callable[[str, int], None]] = None
         backend.set_event_callback(self._event_cb)
 
     # -- lifecycle ----------------------------------------------------------
@@ -129,6 +134,16 @@ class WorkerManager:
             # abort fast. Teardown disarms the callback before deleting
             # the shard pods, so clean-shutdown DELETED events are quiet.
             if event.phase in _TERMINAL:
+                recover = self.on_shard_failure
+                if recover is not None:
+                    logger.error(
+                        "%s shard pod %d %s: routing to recovery plane",
+                        event.replica_type.upper(),
+                        event.worker_id,
+                        event.phase,
+                    )
+                    recover(event.replica_type, event.worker_id)
+                    return
                 cb = self.on_ps_failure
                 if cb is not None:
                     logger.error(

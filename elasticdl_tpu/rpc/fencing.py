@@ -1,0 +1,88 @@
+"""Generation fencing for the shard recovery plane.
+
+Every PS/KV shard servicer carries a `generation` (an integer bumped on
+every relaunch of that shard slot) and every shard-plane request
+carries an `epoch` field naming the generation the client believes it
+is talking to. A mismatch means one of two dangerous situations:
+
+- a ZOMBIE shard: the old process survived the master declaring it
+  dead (network partition, slow kill) and a client with a stale
+  endpoint is about to apply writes to state the job no longer trusts;
+- a STALE CLIENT: the shard was relaunched (new generation) and a
+  client still holding the old generation is pushing against a model
+  whose lineage it never absorbed.
+
+Either way the only correct answer is a hard, NON-retryable rejection:
+the write must requeue through the normal recovery ladder (sync
+failure -> task requeue, docs/fault_model.md rungs 1-3) after the
+client re-resolves endpoints+generations from the master. The server
+maps `EpochFencedError` to grpc FAILED_PRECONDITION, which is absent
+from `policy.RETRYABLE_CODES`, so the retry layer never re-sends a
+fenced call — fencing errors short-circuit straight back to the
+caller's outage handler.
+
+`epoch == UNFENCED` (-1) skips the check: single-generation jobs,
+pre-recovery tests and tooling keep working unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import grpc
+
+#: Request epoch meaning "don't check" — the pre-recovery wire value.
+UNFENCED = -1
+
+
+class EpochFencedError(Exception):
+    """A request's fencing epoch does not match the shard's generation."""
+
+    def __init__(self, kind: str, shard_id: int, generation: int, epoch: int):
+        self.kind = kind
+        self.shard_id = shard_id
+        self.generation = generation
+        self.epoch = epoch
+        super().__init__(
+            f"{kind} shard {shard_id} is at generation {generation}, "
+            f"request carries epoch {epoch}"
+        )
+
+
+def check_epoch(req: dict, generation: int, kind: str, shard_id: int):
+    """Raise EpochFencedError when the request names a different
+    generation. Requests without an epoch (or UNFENCED) pass."""
+    epoch = req.get("epoch", UNFENCED)
+    if epoch is None or epoch == UNFENCED:
+        return
+    if int(epoch) != int(generation):
+        raise EpochFencedError(kind, shard_id, generation, int(epoch))
+
+
+def is_fenced_error(e: Exception) -> bool:
+    """Client-side classification: did this RPC bounce off the fence?
+
+    True for the raw grpc error a fenced handler produces (code
+    FAILED_PRECONDITION, details starting with the exception name the
+    server's abort stamps)."""
+    if isinstance(e, EpochFencedError):
+        return True
+    code = getattr(e, "code", lambda: None)()
+    if code is not grpc.StatusCode.FAILED_PRECONDITION:
+        return False
+    details = getattr(e, "details", lambda: "")() or ""
+    return "EpochFencedError" in details
+
+
+def is_shard_outage(e: Exception) -> bool:
+    """Does this failure mean 'stop retrying this endpoint and
+    re-resolve through the master'? Fenced (the generation moved on),
+    UNAVAILABLE / DEADLINE_EXCEEDED past the retry budget, or an open
+    circuit all route to the recovery plane's re-resolution path."""
+    if is_fenced_error(e):
+        return True
+    code: Optional[grpc.StatusCode] = getattr(e, "code", lambda: None)()
+    return code in (
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+    )

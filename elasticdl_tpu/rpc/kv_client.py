@@ -35,10 +35,13 @@ from elasticdl_tpu.rpc.client import RpcClient
 
 
 class ShardedEmbeddingStore:
-    def __init__(self, endpoints):
+    def __init__(self, endpoints, generations=None):
         if not endpoints:
             raise ValueError("ShardedEmbeddingStore needs >= 1 endpoint")
         self.endpoints = list(endpoints)
+        # fencing epochs per shard (master/recovery.py): stamped on
+        # every request; None = unfenced
+        self.generations = list(generations) if generations else None
         self._clients = [RpcClient(ep) for ep in self.endpoints]
         self._pool = ThreadPoolExecutor(
             max_workers=len(self.endpoints), thread_name_prefix="kv-shard"
@@ -47,6 +50,27 @@ class ShardedEmbeddingStore:
     @property
     def num_shards(self) -> int:
         return len(self._clients)
+
+    def _stamp_epoch(self, req: dict, s: int) -> dict:
+        if self.generations is not None:
+            req["epoch"] = self.generations[s]
+        return req
+
+    def update_endpoints(self, endpoints, generations=None):
+        """Re-resolution after a shard relaunch (master/recovery.py).
+        Shard count is fixed for the job — id placement doesn't
+        re-hash."""
+        if len(endpoints) != len(self.endpoints):
+            raise ValueError(
+                f"re-resolution changed shard count "
+                f"{len(self.endpoints)} -> {len(endpoints)}"
+            )
+        old = self._clients
+        self._clients = [RpcClient(ep) for ep in endpoints]
+        self.endpoints = list(endpoints)
+        self.generations = list(generations) if generations else None
+        for c in old:
+            c.close()
 
     def wait_ready(self, timeout: float = 30.0):
         """One shared deadline across all shards (a serial full-timeout
@@ -81,7 +105,7 @@ class ShardedEmbeddingStore:
             futs[s] = self._pool.submit(
                 self._clients[s].call,
                 "KVLookup",
-                {"layer": layer, "ids": ids[where]},
+                self._stamp_epoch({"layer": layer, "ids": ids[where]}, s),
             )
         values = None
         unknown_parts = []
@@ -123,12 +147,15 @@ class ShardedEmbeddingStore:
                 self._pool.submit(
                     self._clients[s].call,
                     "KVUpdate",
-                    {
-                        "layer": layer,
-                        "ids": ids[where],
-                        "values": values[where],
-                        "set_if_not_exist": set_if_not_exist,
-                    },
+                    self._stamp_epoch(
+                        {
+                            "layer": layer,
+                            "ids": ids[where],
+                            "values": values[where],
+                            "set_if_not_exist": set_if_not_exist,
+                        },
+                        s,
+                    ),
                 )
             )
         for f in futs:
@@ -136,8 +163,10 @@ class ShardedEmbeddingStore:
 
     def snapshot(self) -> Dict[str, Dict[int, np.ndarray]]:
         futs = [
-            self._pool.submit(c.call, "KVSnapshot", {})
-            for c in self._clients
+            self._pool.submit(
+                c.call, "KVSnapshot", self._stamp_epoch({}, s)
+            )
+            for s, c in enumerate(self._clients)
         ]
         merged: Dict[str, Dict[int, np.ndarray]] = {}
         for f in futs:
@@ -162,7 +191,9 @@ class ShardedEmbeddingStore:
                 self._pool.submit(
                     self._clients[s].call,
                     "KVRestore",
-                    {"layers": snapshot_to_arrays(part)},
+                    self._stamp_epoch(
+                        {"layers": snapshot_to_arrays(part)}, s
+                    ),
                 )
             )
         for f in futs:
@@ -172,8 +203,8 @@ class ShardedEmbeddingStore:
         return sum(
             f.result()["n"]
             for f in [
-                self._pool.submit(c.call, "KVLen", {})
-                for c in self._clients
+                self._pool.submit(c.call, "KVLen", self._stamp_epoch({}, s))
+                for s, c in enumerate(self._clients)
             ]
         )
 

@@ -74,6 +74,10 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
         "PSPushDelta",
         "PSOptState",
         "PSOptRestore",
+        # recovery plane (master RPC): the master keeps at most one
+        # restore candidate per (worker, shard) — a resend overwrites
+        # it with the identical payload (master/recovery.py)
+        "PSRestoreFromWorker",
         # KV shard plane: lookup/len/snapshot are reads; update/restore
         # are last-write-wins row overwrites (or SETNX) — a resend
         # rewrites the same rows with the same values
@@ -82,6 +86,12 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
         "KVSnapshot",
         "KVRestore",
         "KVLen",
+        # replica mirroring: KVMirror is the same LWW row overwrite as
+        # KVUpdate (per source shard); KVMirrorSnapshot is a read;
+        # KVSetMirror overwrites one endpoint string
+        "KVMirror",
+        "KVMirrorSnapshot",
+        "KVSetMirror",
     }
 )
 

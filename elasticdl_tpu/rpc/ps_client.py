@@ -24,12 +24,22 @@ from elasticdl_tpu.rpc.client import RpcClient
 class ShardedPS:
     """Fan-out client over the PS shard endpoints."""
 
-    def __init__(self, endpoints: List[str], n_params: int):
+    def __init__(
+        self,
+        endpoints: List[str],
+        n_params: int,
+        generations: Optional[List[int]] = None,
+    ):
         if not endpoints:
             raise ValueError("ShardedPS needs at least one endpoint")
         self.endpoints = list(endpoints)
         self.n_params = int(n_params)
         self.bounds = slice_boundaries(self.n_params, len(endpoints))
+        # fencing epochs (one per shard, master/recovery.py): stamped on
+        # every request so a zombie or relaunched shard whose generation
+        # moved rejects us with FAILED_PRECONDITION instead of silently
+        # applying. None = unfenced (pre-recovery jobs, direct tests).
+        self.generations = list(generations) if generations else None
         self._clients = [RpcClient(ep) for ep in self.endpoints]
         self._pool = ThreadPoolExecutor(
             max_workers=len(endpoints), thread_name_prefix="ps-shard"
@@ -38,6 +48,29 @@ class ShardedPS:
     @property
     def num_shards(self) -> int:
         return len(self.endpoints)
+
+    def _stamp_epoch(self, req: dict, i: int) -> dict:
+        if self.generations is not None:
+            req["epoch"] = self.generations[i]
+        return req
+
+    def update_endpoints(
+        self, endpoints: List[str], generations: Optional[List[int]] = None
+    ):
+        """Re-resolution after a shard relaunch (master/recovery.py):
+        swap in the new endpoint+generation set. The shard COUNT is
+        fixed for the job (slices don't re-split), so bounds stand."""
+        if len(endpoints) != len(self.endpoints):
+            raise ValueError(
+                f"re-resolution changed shard count "
+                f"{len(self.endpoints)} -> {len(endpoints)}"
+            )
+        old = self._clients
+        self._clients = [RpcClient(ep) for ep in endpoints]
+        self.endpoints = list(endpoints)
+        self.generations = list(generations) if generations else None
+        for c in old:
+            c.close()
 
     def wait_ready(self, timeout: float = 30.0):
         """Channel readiness under ONE shared deadline: the waits run
@@ -72,7 +105,20 @@ class ShardedPS:
         carry a per-report `report_key` the shard dedups on
         (ps_shard.py `_is_duplicate`), so a resend whose first attempt
         WAS applied (gRPC can surface UNAVAILABLE after the server
-        processed the request) no-ops instead of double-applying."""
+        processed the request) no-ops instead of double-applying.
+
+        DEDUP RING BOUND. The retry-safety above only holds while the
+        shard still REMEMBERS a report_key, so the ring's capacity must
+        dominate the number of keys that can still be legally resent.
+        A key is resendable only while its originating sync is in
+        flight; each worker holds at most `EDL_SYNC_DEPTH` (default 2,
+        worker.py) syncs in flight, one report_key each, and abandons
+        the key when the sync resolves. Hence at most
+        ``num_workers x max_inflight_syncs`` live keys exist
+        system-wide, and the group sizes each shard's ring as that
+        product with a safety factor (PSShardGroup.dedup_cap_for) —
+        a fixed 512 ring silently broke the guarantee for large fleets
+        (ADVICE r5: 64 workers x 8 deep ring around it in one window)."""
         futs = [
             self._pool.submit(fn, c, i)
             for i, c in enumerate(self._clients)
@@ -89,9 +135,8 @@ class ShardedPS:
 
         def do(c, i):
             s, e = self.bounds[i]
-            return c.call(
-                "PSInit", {"vec": vec[s:e], "version": version}
-            )["version"]
+            req = self._stamp_epoch({"vec": vec[s:e], "version": version}, i)
+            return c.call("PSInit", req)["version"]
 
         # SETNX semantics on the shard make a resend a no-op
         return self._map(do)
@@ -116,7 +161,7 @@ class ShardedPS:
                 req["version"] = versions[i]
             if model_dtype:
                 req["model_dtype"] = model_dtype
-            return c.call("PSPull", req)
+            return c.call("PSPull", self._stamp_epoch(req, i))
 
         resps = self._map(do)  # read-only
         new_versions = [r["version"] for r in resps]
@@ -131,7 +176,7 @@ class ShardedPS:
                 req = {}
                 if model_dtype:
                     req["model_dtype"] = model_dtype
-                return c.call("PSPull", req)
+                return c.call("PSPull", self._stamp_epoch(req, i))
 
             for i, r in zip(
                 missing,
@@ -172,7 +217,7 @@ class ShardedPS:
             }
             if model_dtype:
                 req["model_dtype"] = model_dtype
-            return c.call("PSPushDelta", req)
+            return c.call("PSPushDelta", self._stamp_epoch(req, i))
 
         resps = self._map(do)
         merged = {
@@ -186,16 +231,27 @@ class ShardedPS:
         versions: List[int],
         model_dtype: Optional[str] = None,
         return_model: bool = False,
+        report_key: Optional[str] = None,
     ) -> Tuple[List[int], Optional[np.ndarray]]:
         """Per-step gradient fan-out (async / windowed-sync shards).
         Returns (shard_versions, full_model|None) — the model comes
         back only when return_model was set and every shard advanced
-        past the reported version (async mode always advances)."""
+        past the reported version (async mode always advances).
+
+        `report_key` lets a caller REPLAY a logical push after a shard
+        failover (master/recovery.py): one key spans the whole fan-out,
+        so on the resend the shards that applied the first attempt
+        dedup it while the relaunched shard (restored to the pre-push
+        version) applies it — the partially-torn report heals to
+        exactly-once on every slice, keeping version accounting
+        bit-exact across the failover."""
         grad = np.asarray(grad)
         if grad.size != self.n_params:
             raise ValueError(f"grad size {grad.size} != {self.n_params}")
 
-        report_key = uuid.uuid4().hex  # shard-side dedup: retry-safe
+        # shard-side dedup: retry-safe (and replay-safe when the caller
+        # pins the key)
+        report_key = report_key or uuid.uuid4().hex
 
         def do(c, i):
             s, e = self.bounds[i]
@@ -207,7 +263,7 @@ class ShardedPS:
             }
             if model_dtype:
                 req["model_dtype"] = model_dtype
-            return c.call("PSPushGrad", req)
+            return c.call("PSPushGrad", self._stamp_epoch(req, i))
 
         resps = self._map(do)
         new_versions = [r["version"] for r in resps]
@@ -220,8 +276,17 @@ class ShardedPS:
         """Per-shard optimizer-state leaves (exact resume)."""
         return [
             r["leaves"]
-            for r in self._map(lambda c, i: c.call("PSOptState", {}))
+            for r in self._map(
+                lambda c, i: c.call("PSOptState", self._stamp_epoch({}, i))
+            )
         ]
+
+    def export_opt_shard(self, i: int) -> Optional[list]:
+        """One shard's optimizer-state leaves (the recovery plane's
+        opt-state mirror polls shards independently)."""
+        return self._clients[i].call(
+            "PSOptState", self._stamp_epoch({}, i)
+        )["leaves"]
 
     def restore_opt(self, shards: List[Optional[list]]):
         if len(shards) != self.num_shards:
@@ -231,7 +296,11 @@ class ShardedPS:
                 "--num_ps as the checkpointing job"
             )
         # restore overwrites; a resend is a no-op (retry-safe)
-        self._map(lambda c, i: c.call("PSOptRestore", {"leaves": shards[i]}))
+        self._map(
+            lambda c, i: c.call(
+                "PSOptRestore", self._stamp_epoch({"leaves": shards[i]}, i)
+            )
+        )
 
     def _assemble(self, slices: List[np.ndarray]) -> np.ndarray:
         out = np.empty(self.n_params, dtype=np.asarray(slices[0]).dtype)

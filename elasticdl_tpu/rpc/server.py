@@ -22,9 +22,18 @@ logger = get_logger(__name__)
 
 def _wrap(fn: Callable) -> Callable:
     def handler(request_bytes: bytes, context) -> bytes:
+        from elasticdl_tpu.rpc.fencing import EpochFencedError
+
         req = messages.unpack(request_bytes) if request_bytes else None
         try:
             resp = fn(req) if req is not None else fn({})
+        except EpochFencedError as e:
+            # fencing rejections are a protocol answer, not a bug:
+            # FAILED_PRECONDITION is non-retryable (policy.RETRYABLE_CODES)
+            # so the client re-resolves instead of re-sending (rpc/fencing.py)
+            logger.warning("RPC %s fenced: %s", fn.__name__, e)
+            detail = f"{type(e).__name__}: {e}".replace("\n", " ")[:256]
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, detail)
         except Exception as e:
             logger.exception("RPC handler %s failed", fn.__name__)
             # abort() raises — nothing after it runs. Carry a sanitized

@@ -24,6 +24,7 @@ import inspect
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any, Dict, NamedTuple, Optional
 
@@ -62,6 +63,23 @@ try:
     _BF16 = np.dtype(ml_dtypes.bfloat16)
 except ImportError:  # pragma: no cover
     _BF16 = None
+
+
+def validate_eval_metrics(raw: dict):
+    """Only dicts that ARE mergeable states (api/metrics.py) may ride
+    the eval wire as states: an arbitrary dict would be example-weight
+    summed per key by the eval service, silently producing garbage, so
+    reject it here with the metric's name."""
+    from elasticdl_tpu.api.metrics import is_mergeable_state
+
+    for k, v in raw.items():
+        if isinstance(v, dict) and not is_mergeable_state(v):
+            raise TypeError(
+                f"eval metric {k!r} returned a dict that is not a "
+                "mergeable metric state (missing the 'kind' field — "
+                "see api/metrics.py): return a scalar or build the "
+                "state with a metrics-API helper"
+            )
 
 
 class EmbeddingInput(NamedTuple):
@@ -206,6 +224,12 @@ class Worker:
         self._deferred_reports: list = []  # task results gated on sync
         self._flushed_report_ids: set = set()  # ids already reported by a flush
         self._report_lock = threading.Lock()  # main + sync threads
+        # shard-recovery restore source (master/recovery.py): the last
+        # FULL flat model this worker absorbed from the shards, with
+        # its per-shard version vector — offered to the master via
+        # PSRestoreFromWorker when a PS shard is being recovered.
+        # (versions: list[int], vec: np.float32) under _report_lock.
+        self._restore_snap = None
         self._job_failed = False  # master reported partial completion
         self._is_standby = False  # master holds this worker in reserve
         self._standby_warmed = False  # pre-warm done (model + compile)
@@ -258,7 +282,24 @@ class Worker:
         ):
             from elasticdl_tpu.rpc.ps_client import ShardedPS
 
-            self._ps = ShardedPS(self._ps_endpoints, int(self._flat.size))
+            # fencing epochs: stamp requests with the current shard
+            # generations so a pre-relaunch zombie rejects us instead
+            # of silently absorbing a write against a dead lineage.
+            # Best-effort — a master that predates the field just
+            # leaves us UNFENCED (epoch -1 always passes).
+            generations = None
+            try:
+                cfg = self._master.call("GetPSConfig", {})
+                gens = cfg.get("ps_generations")
+                if gens and len(gens) == len(self._ps_endpoints):
+                    generations = gens
+            except Exception:
+                pass
+            self._ps = ShardedPS(
+                self._ps_endpoints,
+                int(self._flat.size),
+                generations=generations,
+            )
         return self._ps
 
     def pull_model(self, min_version: int = -1, method: str = MethodType.MINIMUM):
@@ -298,6 +339,14 @@ class Worker:
                 self._lineage_version = self._version
                 self._shard_lineage = list(versions)
                 self._lineage_anchor_abs = self._own_steps_abs
+                if vec is not None:
+                    # full assembled model in hand: keep it as the
+                    # shard-recovery restore source (f32 — the wire
+                    # copy may be bf16)
+                    self._restore_snap = (
+                        list(versions),
+                        np.asarray(vec, dtype=np.float32).copy(),
+                    )
             self._fresh = True
             return True
         req = {"version": min_version, "method": method}
@@ -411,9 +460,33 @@ class Worker:
                     base = self._shard_versions or [
                         version
                     ] * self._ps.num_shards
-            versions, vec = self._ps.push_grad(
-                grads_h, base, model_dtype=model_dtype, return_model=True
-            )
+            # the key is pinned OUTSIDE push_grad so a shard failover
+            # mid-fan-out can REPLAY the same logical push: shards that
+            # applied the first attempt dedup the replay, the relaunched
+            # shard (restored to the pre-push version) applies it — the
+            # torn report heals to exactly-once per slice and version
+            # accounting stays bit-exact across the failover
+            push_key = uuid.uuid4().hex
+            try:
+                versions, vec = self._ps.push_grad(
+                    grads_h,
+                    base,
+                    model_dtype=model_dtype,
+                    return_model=True,
+                    report_key=push_key,
+                )
+            except Exception as e:
+                if not self._is_shard_outage_exc(e):
+                    raise
+                if not self._await_shard_recovery(reset=False):
+                    raise  # unrecoverable: fail the task -> requeue
+                versions, vec = self._ps.push_grad(
+                    grads_h,
+                    base,
+                    model_dtype=model_dtype,
+                    return_model=True,
+                    report_key=push_key,
+                )
             meta = {
                 "worker_id": self._id,
                 "versions": versions,
@@ -437,6 +510,16 @@ class Worker:
                     if cur is None
                     else [max(a, b) for a, b in zip(cur, versions)]
                 )
+                if vec is not None:
+                    # every shard handed back its post-apply slice:
+                    # the assembled vector at exactly `versions` is
+                    # the freshest possible recovery restore source
+                    snap = self._restore_snap
+                    if snap is None or min(versions) >= min(snap[0]):
+                        self._restore_snap = (
+                            list(versions),
+                            np.asarray(vec, dtype=np.float32).copy(),
+                        )
             resp = {"accepted": True, "version": min(versions)}
             if vec is not None:
                 # no aux round-trip with the piggybacked model: aux is
@@ -1367,6 +1450,137 @@ class Worker:
         self._pending_losses = []
         self._pending_edl = []
 
+    # ----------------------------------------------- shard-outage recovery
+
+    def _is_shard_outage_exc(self, exc) -> bool:
+        """Did this task failure bottom out in a dead/fenced shard?
+        The shard error usually arrives wrapped (thread-pool fan-out,
+        sync-flush re-raise), so walk the cause/context chain."""
+        if self._ps is None and self._kv is None:
+            return False
+        from elasticdl_tpu.rpc.fencing import is_shard_outage
+
+        e, hops = exc, 0
+        while e is not None and hops < 8:
+            if is_shard_outage(e):
+                return True
+            e = e.__cause__ or e.__context__
+            hops += 1
+        return False
+
+    def _await_shard_recovery(
+        self, deadline: float = 120.0, reset: bool = True
+    ) -> bool:
+        """Ride out a PS/KV shard failover (master/recovery.py).
+
+        Polls GetPSConfig; while the master advertises recovering PS
+        shards, offers this worker's restore snapshot slices via
+        PSRestoreFromWorker (the plane keeps the highest-version offer
+        across all workers). Once the recovering sets clear, re-points
+        the shard clients at the advertised endpoints + generations,
+        drops all local training state (`_reset_local_state` — the
+        failed sync's delta never landed), and returns True; the failed
+        task was already requeued via its failure report, so the run
+        loop just picks up the next task against the recovered shards.
+        `reset=False` is the mid-push REPLAY path (report_gradient):
+        the caller resends the same report_key, so local state is the
+        push's own base and must survive.
+
+        Race guard: an outage noticed here can precede the master
+        noticing the death, so success is declared only after recovery
+        was OBSERVED in progress, or the advertised endpoints or
+        generations differ from what the clients currently hold —
+        otherwise a poll landing in that gap would re-resolve to the
+        same dead endpoint and fail the next task too."""
+        if self._ps is None and self._kv is None:
+            return False
+        start = time.monotonic()
+        observed = False
+        logger.warning(
+            "Worker %d: shard outage detected — waiting for the "
+            "recovery plane", self._id,
+        )
+        while time.monotonic() - start < deadline:
+            try:
+                cfg = self._master.call("GetPSConfig", {})
+            except Exception:
+                time.sleep(0.5)
+                continue
+            rec = cfg.get("recovering") or {}
+            ps_rec = rec.get("ps") or []
+            kv_rec = rec.get("kv") or []
+            if ps_rec or kv_rec:
+                observed = True
+                self._offer_restore_snapshot(ps_rec)
+                time.sleep(0.25)
+                continue
+            eps = cfg.get("endpoints") or []
+            gens = cfg.get("ps_generations") or None
+            kv_eps = cfg.get("kv_endpoints") or []
+            kv_gens = cfg.get("kv_generations") or None
+            changed = False
+            if self._ps is not None and eps:
+                changed |= list(eps) != list(self._ps.endpoints) or (
+                    gens is not None
+                    and list(gens) != list(self._ps.generations or [])
+                )
+            if self._kv is not None and kv_eps:
+                changed |= list(kv_eps) != list(self._kv.endpoints) or (
+                    kv_gens is not None
+                    and list(kv_gens) != list(self._kv.generations or [])
+                )
+            if not (observed or changed):
+                time.sleep(0.25)
+                continue
+            if self._ps is not None and eps:
+                self._ps.update_endpoints(eps, gens)
+            if self._kv is not None and kv_eps:
+                self._kv.update_endpoints(kv_eps, kv_gens)
+            if reset:
+                self._reset_local_state()
+            logger.info(
+                "Worker %d: shard recovery complete — resuming against "
+                "%s", self._id, eps or kv_eps,
+            )
+            return True
+        logger.error(
+            "Worker %d: shard recovery did not complete within %.0fs",
+            self._id, deadline,
+        )
+        return False
+
+    def _offer_restore_snapshot(self, ps_recovering):
+        """Upload this worker's snapshot slices for each fenced PS
+        shard. Best-effort and idempotent: the plane keeps only the
+        highest-version candidate, so duplicate/parallel offers from
+        many workers are absorbed."""
+        if self._ps is None or not ps_recovering:
+            return
+        with self._report_lock:
+            snap = self._restore_snap
+        if snap is None:
+            return
+        versions, vec = snap
+        if len(versions) != len(self._ps.bounds):
+            return  # snapshot predates a resharding: not offerable
+        for sid in ps_recovering:
+            sid = int(sid)
+            if sid >= len(versions):
+                continue
+            lo, hi = self._ps.bounds[sid]
+            try:
+                self._master.call(
+                    "PSRestoreFromWorker",
+                    {
+                        "worker_id": self._id,
+                        "shard_id": sid,
+                        "vec": vec[lo:hi],
+                        "version": int(versions[sid]),
+                    },
+                )
+            except Exception:
+                pass  # next poll retries
+
     def _absorb_sync_result(self):
         """Apply a piggybacked merged model (another worker advanced
         the PS) — device ops, main thread only. Version bookkeeping
@@ -1775,7 +1989,8 @@ class Worker:
                 raw = self._spec.eval_metrics_fn(outputs, jnp.asarray(labels))
                 # scalars go over the wire as floats; mergeable states
                 # (api/metrics.py) as host arrays — the eval service
-                # sums states and finalizes exactly at job completion
+                # sums states and finalizes exactly at job completion.
+                validate_eval_metrics(raw)
                 metrics = {
                     k: (
                         {
@@ -1971,6 +2186,7 @@ class Worker:
                 continue
             err = ""
             reported = False
+            shard_outage = False
             with self._report_lock:
                 # The flushed-id set exists solely so THIS iteration's
                 # end can tell "my report was already handled by a
@@ -1998,11 +2214,19 @@ class Worker:
                         "Worker %d task %d failed", self._id, task.task_id
                     )
                     err = f"{type(e).__name__}: {e}"
+                    shard_outage = self._is_shard_outage_exc(e)
                 with self._report_lock:
                     flushed = task.task_id in self._flushed_report_ids
                     self._flushed_report_ids.discard(task.task_id)
                 if not reported and not flushed:
                     self.report_task_result(task.task_id, err)
+                if shard_outage:
+                    # the task failure was a dead/fenced shard, not a
+                    # task bug: the failure report above requeued the
+                    # task, so ride out the failover and resume from
+                    # the recovered shards instead of crash-looping on
+                    # the dead endpoint
+                    self._await_shard_recovery()
 
     def _standby_prewarm(self):
         """Warm-standby boot: pull the model and AOT-compile the train

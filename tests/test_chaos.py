@@ -567,3 +567,232 @@ def test_chaos_stress_high_fault_rate(tmp_path, monkeypatch):
     assert out["versions"] == [16, 16]
     assert out["applied"] == 32
     assert out["duplicates"] >= 1
+
+
+# -- shard failover e2e (recovery plane, fault-model rung 6) -----------------
+
+
+def _run_failover_job(tmp, tag, monkeypatch, chaos_spec, deepfm=False):
+    """One ProcessBackend job with PROCESS-mode PS shards (plus
+    process-mode KV shards for the deepfm variant) under a manually
+    wired recovery plane. Mirrors _run_training_job, except shard
+    deaths are real subprocess exits the plane must detect (poll_dead),
+    fence, relaunch at a bumped generation, and restore."""
+    from elasticdl_tpu.cluster.pod_backend import ProcessBackend
+    from elasticdl_tpu.common.args import master_parser, worker_forward_args
+    from elasticdl_tpu.common.constants import (
+        ENV_RPC_BACKOFF,
+        ENV_RPC_RETRIES,
+    )
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.master.recovery import RecoveryPlane
+    from elasticdl_tpu.master.worker_manager import WorkerManager
+
+    if chaos_spec is None:
+        monkeypatch.delenv(ENV_SPEC, raising=False)
+    else:
+        monkeypatch.setenv(ENV_SPEC, json.dumps(chaos_spec))
+    if deepfm:
+        import elasticdl_tpu.models as _models
+
+        model_argv = [
+            "--model_zoo", os.path.dirname(os.path.abspath(_models.__file__)),
+            "--model_def", "deepfm_edl_embedding.custom_model",
+            "--minibatch_size", "8",
+            # ONE minibatch per task: every KV lookup then happens
+            # BEFORE its task's only push, so a lookup outage fails the
+            # task pre-push and the requeue re-runs it exactly (the
+            # master-side lookup path instead rides through recovery —
+            # see servicer._apply_sparse)
+            "--records_per_task", "8",
+            "--num_kv_shards", "2",
+            "--kv_mode", "process",
+        ]
+    else:
+        model_argv = [
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--records_per_task", "16",
+        ]
+    args = master_parser().parse_args(
+        model_argv
+        + [
+            "--training_data_dir", tmp,
+            "--num_epochs", "2",
+            "--grads_to_wait", "1",
+            "--num_workers", "2",
+            "--worker_backend", "process",
+            "--num_ps", "2",
+            "--ps_mode", "process",
+            "--staleness_window", "1",
+        ]
+    )
+    _spec, dispatcher, servicer, _evs, _ckpt = build_master(args, "training")
+    unrecoverable = []
+    plane = RecoveryPlane(
+        servicer,
+        ps_group=servicer.ps_group,
+        kv_group=servicer.kv_group,
+        opt_mirror_interval=0.25,
+        on_unrecoverable=lambda kind, sid: unrecoverable.append((kind, sid)),
+    )
+    servicer.set_recovery_plane(plane)
+    plane.start()
+    server = RpcServer(servicer.handlers(), port=0)
+    server.start()
+    addr = f"localhost:{server.port}"
+    log_dir = os.path.join(tmp, f"logs-{tag}")
+    backend = ProcessBackend(log_dir=log_dir)
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=2,
+        worker_argv_fn=lambda wid: worker_forward_args(args, wid, addr),
+        envs={
+            "JAX_PLATFORMS": "cpu",
+            # small retry budget: a dead shard surfaces as an outage in
+            # well under a second instead of riding the production
+            # backoff ladder, so workers reach _await_shard_recovery
+            # while the fault is still mid-training
+            ENV_RPC_RETRIES: "3",
+            ENV_RPC_BACKOFF: "0.05",
+        },
+        max_relaunches=4,
+    )
+    manager.on_shard_failure = plane.on_shard_failure
+    manager.start_workers()
+    try:
+        deadline = time.time() + 420
+        while not dispatcher.finished():
+            assert time.time() < deadline, f"job[{tag}] stuck"
+            assert not manager.all_exited(), f"job[{tag}]: all workers gone"
+            assert not unrecoverable, f"job[{tag}]: gave up on {unrecoverable}"
+            time.sleep(0.05)
+        assert not dispatcher.has_failed_tasks()
+        versions, _vec = servicer.ps_group.assemble()
+        return {
+            "completed_records": dispatcher.completed_records(),
+            "versions": list(versions),
+            "recoveries": plane.recoveries(),
+            "ps_generations": list(servicer.ps_group.generations),
+            "kv_generations": (
+                list(servicer.kv_group.generations)
+                if servicer.kv_group is not None
+                else []
+            ),
+            "unrecoverable": list(unrecoverable),
+            "log_dir": log_dir,
+        }
+    finally:
+        manager.on_shard_failure = None
+        plane.stop()
+        manager.stop_relaunch_and_remove_workers()
+        backend.stop()
+        server.stop()
+        if servicer.kv_group is not None:
+            servicer.kv_group.stop()
+        if servicer.ps_group is not None:
+            servicer.ps_group.stop()
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+def test_ps_shard_failover_exact_versions(tmp_path, monkeypatch):
+    """Dense-plane failover: PS shard 1 (a real subprocess) is crashed
+    server-side BEFORE applying a push, tearing the report across the
+    fan-out (its pair shard may already have applied the same
+    report_key). The recovery plane must fence the slot, relaunch it at
+    generation 1, and restore params from a worker flat-buffer upload
+    plus opt state from the master's mirror ring; the workers replay
+    the torn report under its pinned key. The job must finish WITHOUT a
+    master restart at final shard versions identical to a fault-free
+    run — the torn push healed to exactly-once per slice."""
+    from elasticdl_tpu.testing import write_linear_records
+
+    tmp = str(tmp_path)
+    for i in range(2):
+        write_linear_records(
+            os.path.join(tmp, f"shard-{i}.rio"), 64, seed=i, noise=0.05
+        )
+    chaos_spec = {
+        "seed": 31,
+        "faults": [
+            {"kind": "crash", "methods": ["PSPushGrad"], "roles": ["ps"],
+             "targets": ["1"], "side": "server", "nth": 5,
+             "when": "before",
+             "once_file": os.path.join(tmp, "ps-crash.once")},
+        ],
+    }
+    under_chaos = _run_failover_job(tmp, "failover", monkeypatch, chaos_spec)
+    fault_free = _run_failover_job(tmp, "clean", monkeypatch, None)
+
+    assert os.path.exists(os.path.join(tmp, "ps-crash.once"))
+    assert under_chaos["completed_records"] == 256
+    assert fault_free["completed_records"] == 256
+    # the slot was recovered IN PLACE at a bumped fencing generation
+    assert ("ps", 1, 1) in under_chaos["recoveries"]
+    assert under_chaos["ps_generations"] == [0, 1]
+    assert under_chaos["unrecoverable"] == []
+    # 256 records / minibatch 16 = 16 pushes per shard, exactly once
+    assert under_chaos["versions"] == fault_free["versions"] == [16, 16]
+    assert fault_free["recoveries"] == []
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+def test_shard_failover(tmp_path, monkeypatch):
+    """THE recovery-plane acceptance e2e (fault-model rung 6): one job
+    loses one PS shard AND one KV shard mid-training — both real
+    subprocess crashes — and must recover without a master restart and
+    finish with final model versions exactly equal to the fault-free
+    run.
+
+    PS shard 1 dies before a push (torn report -> pinned-key replay +
+    worker-upload restore). KV shard 0 dies on a lookup: a worker-side
+    lookup fails its single-minibatch task BEFORE the push (exact
+    requeue), a master-side lookup rides through recovery inside
+    _apply_sparse; either way the restored shard gets its rows back
+    from the ring pair's mirror."""
+    from elasticdl_tpu.models import deepfm_edl_embedding as dfm
+    from elasticdl_tpu.models import record_codec as rc
+
+    tmp = str(tmp_path)
+    for i in range(2):
+        rc.write_synthetic_tabular_records(
+            os.path.join(tmp, f"shard-{i}.rio"), 32, dfm.NUM_FIELDS, 50,
+            seed=i,
+        )
+    chaos_spec = {
+        "seed": 37,
+        "faults": [
+            {"kind": "crash", "methods": ["PSPushGrad"], "roles": ["ps"],
+             "targets": ["1"], "side": "server", "nth": 5,
+             "when": "before",
+             "once_file": os.path.join(tmp, "ps-crash.once")},
+            {"kind": "crash", "methods": ["KVLookup"], "roles": ["kv"],
+             "targets": ["0"], "side": "server", "nth": 6,
+             "when": "before",
+             "once_file": os.path.join(tmp, "kv-crash.once")},
+        ],
+    }
+    under_chaos = _run_failover_job(
+        tmp, "failover", monkeypatch, chaos_spec, deepfm=True
+    )
+    fault_free = _run_failover_job(
+        tmp, "clean", monkeypatch, None, deepfm=True
+    )
+
+    assert os.path.exists(os.path.join(tmp, "ps-crash.once"))
+    assert os.path.exists(os.path.join(tmp, "kv-crash.once"))
+    assert under_chaos["completed_records"] == 128
+    assert fault_free["completed_records"] == 128
+    assert ("ps", 1, 1) in under_chaos["recoveries"]
+    assert ("kv", 0, 1) in under_chaos["recoveries"]
+    assert under_chaos["ps_generations"] == [0, 1]
+    assert under_chaos["kv_generations"] == [1, 0]
+    assert under_chaos["unrecoverable"] == []
+    # 128 records / minibatch 8 = 16 pushes per dense shard, exactly
+    # once — KV row values are bounded-staleness, versions are not
+    assert under_chaos["versions"] == fault_free["versions"] == [16, 16]
+    assert fault_free["recoveries"] == []
