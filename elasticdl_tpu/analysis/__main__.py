@@ -1,7 +1,15 @@
-"""CLI: python -m elasticdl_tpu.analysis [--rule ...] [--format text|json]
+"""CLI: python -m elasticdl_tpu.analysis [--rule ...]
+[--format text|json|github] [--list-rules]
 
 Exit codes: 0 — no findings beyond the baseline; 1 — new findings (or
 stale baseline entries under --strict-baseline); 2 — usage error.
+
+``--format github`` renders every NEW finding as a GitHub Actions
+workflow command (``::error file=...,line=...::message``) so the CI
+analysis job surfaces findings as inline PR annotations, followed by
+the usual text summary. ``--list-rules`` prints the registered rule
+families with their one-line descriptions and exits — CI and docs
+reference this instead of hardcoding the set.
 """
 
 from __future__ import annotations
@@ -15,6 +23,7 @@ from elasticdl_tpu.analysis.core import (
     RULE_FAMILIES,
     apply_baseline,
     load_baseline,
+    rule_descriptions,
     run_analysis,
     save_baseline,
 )
@@ -38,8 +47,13 @@ def main(argv=None) -> int:
         help="run only this rule family (repeatable; default: all)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="finding output format (default: text)",
+        "--format", choices=("text", "json", "github"), default="text",
+        help="finding output format (default: text); 'github' emits "
+        "::error workflow commands for PR annotations",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the registered rule families and exit",
     )
     parser.add_argument(
         "--root", default=_PKG_ROOT,
@@ -63,6 +77,11 @@ def main(argv=None) -> int:
         "should be removed from the baseline)",
     )
     args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name, desc in rule_descriptions().items():
+            print(f"{name:20s} {desc}")
+        return 0
 
     if not os.path.isdir(args.root):
         print(f"error: --root {args.root} is not a directory", file=sys.stderr)
@@ -94,8 +113,21 @@ def main(argv=None) -> int:
             )
         )
     else:
+        # annotations must be repo-relative, findings are root-relative
+        rel_root = os.path.relpath(args.root).replace(os.sep, "/")
+        prefix = "" if rel_root.startswith("..") or rel_root == "." else (
+            rel_root + "/"
+        )
         for f in new:
-            print(f.render())
+            if args.format == "github":
+                # one annotation per finding; %0A etc. escaping is not
+                # needed — messages are single-line by construction
+                print(
+                    f"::error file={prefix}{f.path},line={f.line},"
+                    f"title={f.rule}/{f.check}::{f.message}"
+                )
+            else:
+                print(f.render())
         if stale and (args.strict_baseline or not new):
             for key in stale:
                 print(f"stale baseline entry (finding no longer occurs): {key}")

@@ -1,0 +1,143 @@
+"""abort-discipline: handler exception paths end classified, not eaten.
+
+The RPC server (rpc/server.py `_wrap`) is the classification point:
+EpochFencedError -> FAILED_PRECONDITION abort, anything else ->
+INTERNAL abort. That contract only holds if the exception actually
+REACHES the wrapper — a bare ``except:`` or broad ``except Exception``
+anywhere on a handler's call path can eat an EpochFencedError (the
+zombie write then "succeeds") or a chaos-injected fault (the failure
+the chaos harness planted disappears instead of exercising a recovery
+rung). This rule walks every registered RPC handler and every function
+reachable from one through the call graph and flags swallowing
+handlers.
+
+An except clause passes when it re-raises (a ``raise`` anywhere in its
+body, including conditional re-raise patterns) or classifies the
+failure itself (a ``.abort(...)`` call). Deliberate sinks — a metrics
+hook that must never fail training — carry the usual reasoned
+suppression.
+
+Checks:
+
+- ``swallowed-exception``  broad/bare except on a handler-reachable
+                           path with no re-raise and no abort
+- ``fence-swallowed``      an ``except EpochFencedError`` on a
+                           handler-reachable path that neither
+                           re-raises nor aborts — the fencing protocol
+                           is silently defeated
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from elasticdl_tpu.analysis.callgraph import CallGraph, FuncKey
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+from elasticdl_tpu.analysis.rpc_conformance import _collect_handlers
+
+RULE = "abort-discipline"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _type_names(handler: ast.ExceptHandler) -> Set[str]:
+    if handler.type is None:
+        return {""}  # bare except
+    elts = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    names = set()
+    for e in elts:
+        if isinstance(e, ast.Attribute):
+            names.add(e.attr)
+        elif isinstance(e, ast.Name):
+            names.add(e.id)
+    return names
+
+
+def _handler_reachable(g: CallGraph, roots: List[FuncKey]) -> Dict[FuncKey, str]:
+    """{function key: method name of one registering handler} for every
+    function reachable from a registered handler (smallest method name
+    wins, for deterministic messages)."""
+    out: Dict[FuncKey, str] = {}
+    for root, method in sorted(roots, key=lambda rm: rm[1]):
+        stack = [root]
+        while stack:
+            key = stack.pop()
+            if key in out:
+                continue
+            out[key] = method
+            for edge in g.edges.get(key, []):
+                if edge.callee not in out:
+                    stack.append(edge.callee)
+    return out
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    g = CallGraph(ctx)
+    handlers = _collect_handlers(ctx)
+    roots = []
+    for h in handlers.values():
+        if h.func is None:
+            continue
+        cls_name = h.cls.name if h.cls is not None else None
+        key = (h.path, cls_name, h.func.name)
+        if key in g.functions:
+            roots.append((key, h.method))
+    reachable = _handler_reachable(g, roots)
+
+    findings: List[Finding] = []
+    for key, via in sorted(
+        reachable.items(), key=lambda kv: (kv[0][0], kv[0][1] or "", kv[0][2])
+    ):
+        func = g.functions[key]
+        # scan only this function's own except clauses (nested defs are
+        # separate graph nodes and handled on their own)
+        nested = {
+            n
+            for stmt in ast.walk(func.node)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and stmt is not func.node
+            for n in ast.walk(stmt)
+        }
+        for node in ast.walk(func.node):
+            if not isinstance(node, ast.ExceptHandler) or node in nested:
+                continue
+            names = _type_names(node)
+            reraises = any(isinstance(n, ast.Raise) for n in ast.walk(node))
+            aborts = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "abort"
+                for n in ast.walk(node)
+            )
+            if reraises or aborts:
+                continue
+            if "EpochFencedError" in names:
+                findings.append(
+                    Finding(
+                        RULE, "fence-swallowed", func.path, node.lineno,
+                        f"{func.qualname} (reachable from RPC handler "
+                        f"{via}) catches EpochFencedError without "
+                        "re-raising or aborting — the fencing protocol "
+                        "is silently defeated",
+                    )
+                )
+            elif names & _BROAD or "" in names:
+                caught = "bare except" if "" in names else (
+                    "except " + "/".join(sorted(names & _BROAD))
+                )
+                findings.append(
+                    Finding(
+                        RULE, "swallowed-exception", func.path, node.lineno,
+                        f"{func.qualname} (reachable from RPC handler "
+                        f"{via}) swallows exceptions ({caught}) with no "
+                        "re-raise and no classified abort — an "
+                        "EpochFencedError or chaos fault dies here "
+                        "instead of reaching the server's classifier",
+                    )
+                )
+    return findings

@@ -1,0 +1,499 @@
+"""edl-verify engine: repo-wide call graph + attribute dataflow.
+
+The per-file rules (lock_discipline, rpc_conformance) see one function
+at a time; the protocol invariants introduced by the recovery plane
+(rpc/fencing.py, master/recovery.py) span *calls*: a fence check lives
+two frames below the handler, a blocking RPC hides three frames below
+a held servicer lock, a lock acquisition order only exists across
+methods. This module builds the whole-tree view those rules need —
+from the AST alone, like everything in this package (nothing here
+imports the analyzed code, so edl-verify runs without jax/grpc).
+
+What it resolves, deliberately conservatively (a call that cannot be
+resolved statically produces NO edge, so every edge is real):
+
+- ``self.m(...)``            -> a method of the enclosing class
+- ``helper(...)``            -> a module-level function of the same file,
+                                or a nested ``def`` of the enclosing one
+- ``from a.b import f; f()`` -> ``f`` in the analyzed file ``a/b.py``
+- ``self.x.m(...)``          -> ``C.m`` when the class assigns
+                                ``self.x = C(...)`` (attribute dataflow;
+                                ctor-resolved types only — attributes
+                                bound from parameters stay opaque)
+
+Alongside the edges it records, per function, which locks are held at
+each call / acquisition / blocking operation. Lock identity is
+``(owner, attr)``: ``self._lock = threading.Lock()`` in class ``C`` of
+``m.py`` is ``("m.py::C", "_lock")``; module-level locks use the bare
+path. ``threading.Condition(self._lock)`` aliases to the wrapped lock
+(acquiring the condition IS acquiring the lock); a bare ``Condition()``
+owns its own. Closures and lambdas get their own nodes — locks held in
+the spawning frame are NOT held when the closure later runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from elasticdl_tpu.analysis.core import AnalysisContext
+
+#: (path or "path::Class", attribute/name of the lock)
+LockId = Tuple[str, str]
+#: (path, class name or None, function name — dotted for nested defs)
+FuncKey = Tuple[str, Optional[str], str]
+
+_BLOCKING_ATTRS = {"call", "result", "join", "wait", "wait_ready"}
+_LOCK_CTORS = ("Lock", "RLock")
+
+
+def blocking_desc(node: ast.Call) -> Optional[str]:
+    """Same heuristic as lock_discipline._blocking_name: time.sleep and
+    the wait-shaped attribute calls, with ``.call`` counting only in
+    RPC form (string method name)."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "sleep" and isinstance(f.value, ast.Name) and f.value.id == "time":
+        return "time.sleep"
+    if f.attr in _BLOCKING_ATTRS:
+        if f.attr == "call":
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                return None
+            return f'.call("{node.args[0].value}")'
+        return f".{f.attr}()"
+    return None
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class FunctionInfo:
+    def __init__(self, key: FuncKey, node: ast.AST):
+        self.key = key
+        self.node = node  # FunctionDef / AsyncFunctionDef
+
+    @property
+    def qualname(self) -> str:
+        _, cls, name = self.key
+        return f"{cls}.{name}" if cls else name
+
+    @property
+    def path(self) -> str:
+        return self.key[0]
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+class CallEdge:
+    def __init__(self, callee: FuncKey, line: int, held: Tuple[LockId, ...]):
+        self.callee = callee
+        self.line = line
+        self.held = held
+
+
+class Acquire:
+    def __init__(self, lock: LockId, line: int, held: Tuple[LockId, ...]):
+        self.lock = lock
+        self.line = line
+        self.held = held
+
+
+class Blocking:
+    def __init__(self, desc: str, line: int, held: Tuple[LockId, ...]):
+        self.desc = desc
+        self.line = line
+        self.held = held
+
+
+class _ClassInfo:
+    def __init__(self, path: str, node: ast.ClassDef):
+        self.path = path
+        self.node = node
+        self.methods: Dict[str, ast.AST] = {
+            n.name: n
+            for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.lock_attrs: Dict[str, LockId] = {}
+        self.lock_kinds: Dict[LockId, str] = {}  # "Lock"|"RLock"|"Condition"
+        self.attr_types: Dict[str, Tuple[str, str]] = {}  # attr -> class
+
+
+def _called_ctor(value: ast.expr) -> Optional[str]:
+    """Class-name candidate of ``self.x = Name(...)`` / ``mod.Name(...)``."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+class CallGraph:
+    """Whole-tree call graph with per-site held-lock context."""
+
+    def __init__(self, ctx: AnalysisContext):
+        self.functions: Dict[FuncKey, FunctionInfo] = {}
+        self.edges: Dict[FuncKey, List[CallEdge]] = {}
+        self.acquires: Dict[FuncKey, List[Acquire]] = {}
+        self.blocking: Dict[FuncKey, List[Blocking]] = {}
+        self.classes: Dict[Tuple[str, str], _ClassInfo] = {}
+        self.lock_kinds: Dict[LockId, str] = {}
+        self._module_funcs: Dict[str, Dict[str, FuncKey]] = {}
+        self._module_locks: Dict[str, Dict[str, LockId]] = {}
+        self._imports: Dict[str, Dict[str, tuple]] = {}
+        self._modnames: Dict[str, str] = {}  # dotted (relative) -> path
+        self._trans_acquires: Dict[FuncKey, Set[LockId]] = {}
+        self._trans_blocking: Dict[FuncKey, bool] = {}
+        self._collect(ctx)
+        self._walk_bodies(ctx)
+
+    # -- collection ----------------------------------------------------------
+
+    def _collect(self, ctx: AnalysisContext) -> None:
+        for path, tree in ctx.trees():
+            dotted = path[:-3].replace("/", ".")
+            if dotted.endswith(".__init__"):
+                dotted = dotted[: -len(".__init__")]
+            self._modnames[dotted] = path
+            self._module_funcs[path] = {}
+            self._module_locks[path] = {}
+            self._imports[path] = imp = {}
+            for node in tree.body:
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        imp[a.asname or a.name.split(".")[0]] = ("mod", a.name)
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for a in node.names:
+                        imp[a.asname or a.name] = ("sym", node.module, a.name)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    key = (path, None, node.name)
+                    self.functions[key] = FunctionInfo(key, node)
+                    self._module_funcs[path][node.name] = key
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name) and self._lock_ctor_kind(
+                        node.value
+                    ) in _LOCK_CTORS:
+                        self._module_locks[path][t.id] = (path, t.id)
+                        self.lock_kinds[(path, t.id)] = self._lock_ctor_kind(
+                            node.value
+                        )
+                elif isinstance(node, ast.ClassDef):
+                    self._collect_class(path, node)
+
+    @staticmethod
+    def _lock_ctor_kind(value: ast.expr) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        f = value.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        return name if name in ("Lock", "RLock", "Condition") else None
+
+    def _collect_class(self, path: str, node: ast.ClassDef) -> None:
+        info = _ClassInfo(path, node)
+        self.classes[(path, node.name)] = info
+        owner = f"{path}::{node.name}"
+        for name, fn in info.methods.items():
+            key = (path, node.name, name)
+            self.functions[key] = FunctionInfo(key, fn)
+        # two passes: plain locks first so Condition(self._lock) aliases
+        assigns = [
+            n
+            for n in ast.walk(node)
+            if isinstance(n, ast.Assign) and len(n.targets) == 1
+        ]
+        for n in assigns:
+            attr = _self_attr(n.targets[0])
+            kind = self._lock_ctor_kind(n.value)
+            if attr and kind in _LOCK_CTORS:
+                lock = (owner, attr)
+                info.lock_attrs[attr] = lock
+                self.lock_kinds[lock] = kind
+        for n in assigns:
+            attr = _self_attr(n.targets[0])
+            kind = self._lock_ctor_kind(n.value)
+            if not attr or kind != "Condition":
+                continue
+            wrapped = (
+                _self_attr(n.value.args[0]) if n.value.args else None
+            )
+            if wrapped and wrapped in info.lock_attrs:
+                info.lock_attrs[attr] = info.lock_attrs[wrapped]
+            else:
+                lock = (owner, attr)
+                info.lock_attrs[attr] = lock
+                self.lock_kinds[lock] = "Condition"
+
+    def _resolve_module(self, dotted: str) -> Optional[str]:
+        parts = dotted.split(".")
+        for i in range(len(parts)):
+            path = self._modnames.get(".".join(parts[i:]))
+            if path is not None:
+                return path
+        return None
+
+    def _resolve_class(self, path: str, name: str) -> Optional[Tuple[str, str]]:
+        if (path, name) in self.classes:
+            return (path, name)
+        imp = self._imports.get(path, {}).get(name)
+        if imp and imp[0] == "sym":
+            target = self._resolve_module(imp[1])
+            if target and (target, imp[2]) in self.classes:
+                return (target, imp[2])
+        return None
+
+    # -- body walk -----------------------------------------------------------
+
+    def _walk_bodies(self, ctx: AnalysisContext) -> None:
+        # attribute dataflow first, so self.x.m() resolves during the walk
+        for (path, _cls_name), info in self.classes.items():
+            for n in ast.walk(info.node):
+                if not (
+                    isinstance(n, ast.Assign) and len(n.targets) == 1
+                ):
+                    continue
+                attr = _self_attr(n.targets[0])
+                ctor = _called_ctor(n.value)
+                if attr and ctor:
+                    target = self._resolve_class(path, ctor)
+                    if target is not None:
+                        info.attr_types[attr] = target
+        for key in list(self.functions):
+            self._walk_function(key)
+
+    def _walk_function(self, key: FuncKey) -> None:
+        info = self.functions[key]
+        path, cls_name, _ = key
+        cls = self.classes.get((path, cls_name)) if cls_name else None
+        self.edges.setdefault(key, [])
+        self.acquires.setdefault(key, [])
+        self.blocking.setdefault(key, [])
+        local_defs: Dict[str, FuncKey] = {}
+        self._walk_block(key, info.node.body, (), cls, local_defs)
+
+    def _walk_block(
+        self,
+        key: FuncKey,
+        stmts: Sequence[ast.stmt],
+        held: Tuple[LockId, ...],
+        cls: Optional[_ClassInfo],
+        local_defs: Dict[str, FuncKey],
+    ) -> None:
+        for stmt in stmts:
+            self._walk_stmt(key, stmt, held, cls, local_defs)
+
+    def _walk_stmt(
+        self,
+        key: FuncKey,
+        stmt: ast.stmt,
+        held: Tuple[LockId, ...],
+        cls: Optional[_ClassInfo],
+        local_defs: Dict[str, FuncKey],
+    ) -> None:
+        path, cls_name, fname = key
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = (path, cls_name, f"{fname}.{stmt.name}")
+            self.functions[sub] = FunctionInfo(sub, stmt)
+            local_defs[stmt.name] = sub
+            self.edges.setdefault(sub, [])
+            self.acquires.setdefault(sub, [])
+            self.blocking.setdefault(sub, [])
+            # the closure runs with NO inherited held locks
+            self._walk_block(sub, stmt.body, (), cls, dict(local_defs))
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in stmt.items:
+                lock = self._lock_of(item.context_expr, cls, path)
+                if lock is not None:
+                    self.acquires[key].append(
+                        Acquire(lock, stmt.lineno, inner)
+                    )
+                    if lock not in inner:
+                        inner = inner + (lock,)
+                else:
+                    self._scan_exprs(key, [item.context_expr], held, cls, local_defs)
+            self._walk_block(key, stmt.body, inner, cls, local_defs)
+            return
+        # compound statements: recurse into bodies with the same held set
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self._walk_block(key, sub, held, cls, local_defs)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._walk_block(key, handler.body, held, cls, local_defs)
+        self._scan_exprs(
+            key, self._own_exprs(stmt), held, cls, local_defs
+        )
+
+    @staticmethod
+    def _own_exprs(stmt: ast.stmt) -> List[ast.expr]:
+        """Expressions belonging to `stmt` itself, not its sub-blocks."""
+        out: List[ast.expr] = []
+        for field, value in ast.iter_fields(stmt):
+            if field in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.expr):
+                out.append(value)
+            elif isinstance(value, list):
+                out.extend(v for v in value if isinstance(v, ast.expr))
+        return out
+
+    def _scan_exprs(
+        self,
+        key: FuncKey,
+        exprs: Sequence[ast.expr],
+        held: Tuple[LockId, ...],
+        cls: Optional[_ClassInfo],
+        local_defs: Dict[str, FuncKey],
+    ) -> None:
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Lambda):
+                    # treated like a closure: body runs later, lock-free
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                desc = blocking_desc(node)
+                if desc is not None:
+                    self.blocking[key].append(
+                        Blocking(desc, node.lineno, held)
+                    )
+                callee = self._resolve_call(key, node, cls, local_defs)
+                if callee is not None:
+                    self.edges[key].append(
+                        CallEdge(callee, node.lineno, held)
+                    )
+
+    def _lock_of(
+        self, expr: ast.expr, cls: Optional[_ClassInfo], path: str
+    ) -> Optional[LockId]:
+        attr = _self_attr(expr)
+        if attr and cls is not None:
+            return cls.lock_attrs.get(attr)
+        if isinstance(expr, ast.Name):
+            return self._module_locks.get(path, {}).get(expr.id)
+        return None
+
+    def _resolve_call(
+        self,
+        key: FuncKey,
+        node: ast.Call,
+        cls: Optional[_ClassInfo],
+        local_defs: Dict[str, FuncKey],
+    ) -> Optional[FuncKey]:
+        path = key[0]
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in local_defs:
+                return local_defs[f.id]
+            target = self._module_funcs.get(path, {}).get(f.id)
+            if target is not None:
+                return target
+            imp = self._imports.get(path, {}).get(f.id)
+            if imp and imp[0] == "sym":
+                mod = self._resolve_module(imp[1])
+                if mod is not None:
+                    return self._module_funcs.get(mod, {}).get(imp[2])
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        # self.m(...)
+        if isinstance(f.value, ast.Name) and f.value.id == "self":
+            if cls is not None and f.attr in cls.methods:
+                return (cls.path, cls.node.name, f.attr)
+            return None
+        # self.x.m(...) via attribute dataflow
+        inner = _self_attr(f.value)
+        if inner and cls is not None:
+            target = cls.attr_types.get(inner)
+            if target is not None and f.attr in self.classes[target].methods:
+                return (target[0], target[1], f.attr)
+            return None
+        # mod.f(...)
+        if isinstance(f.value, ast.Name):
+            imp = self._imports.get(path, {}).get(f.value.id)
+            if imp and imp[0] == "mod":
+                mod = self._resolve_module(imp[1])
+                if mod is not None:
+                    return self._module_funcs.get(mod, {}).get(f.attr)
+        return None
+
+    # -- queries -------------------------------------------------------------
+
+    def transitive_acquires(self, key: FuncKey) -> Set[LockId]:
+        """Locks `key` may acquire, itself or through any resolved call."""
+        memo = self._trans_acquires
+        if key in memo:
+            return memo[key]
+        memo[key] = set()  # cycle guard: in-progress nodes contribute {}
+        out: Set[LockId] = {a.lock for a in self.acquires.get(key, [])}
+        for edge in self.edges.get(key, []):
+            out |= self.transitive_acquires(edge.callee)
+        memo[key] = out
+        return out
+
+    def may_block(self, key: FuncKey) -> bool:
+        """Does `key` reach a blocking operation, itself or below?"""
+        memo = self._trans_blocking
+        if key in memo:
+            return memo[key]
+        memo[key] = False
+        out = bool(self.blocking.get(key))
+        if not out:
+            out = any(
+                self.may_block(e.callee) for e in self.edges.get(key, [])
+            )
+        memo[key] = out
+        return out
+
+    def blocking_chain(self, key: FuncKey) -> Optional[List[str]]:
+        """Shortest qualname chain from `key` to a blocking op, the op
+        itself last — e.g. ['A.f', 'B.g', '.result()']."""
+        seen = {key}
+        q = deque([(key, [self.functions[key].qualname])])
+        while q:
+            cur, chain = q.popleft()
+            blk = self.blocking.get(cur)
+            if blk:
+                descs = sorted(b.desc for b in blk)
+                return chain + [descs[0]]
+            for edge in sorted(
+                self.edges.get(cur, []),
+                key=lambda e: (e.callee[0], e.callee[1] or "", e.callee[2]),
+            ):
+                if edge.callee not in seen:
+                    seen.add(edge.callee)
+                    q.append(
+                        (
+                            edge.callee,
+                            chain + [self.functions[edge.callee].qualname],
+                        )
+                    )
+        return None
+
+    def lock_name(self, lock: LockId) -> str:
+        owner, attr = lock
+        if "::" in owner:
+            return f"{owner.split('::', 1)[1]}.{attr}"
+        return attr

@@ -12,10 +12,19 @@ the lint runs without jax/grpc and can lint broken trees).
 
 Rule families (one module each):
 
-- ``rpc-conformance``  (rpc_conformance.py)
-- ``lock-discipline``  (lock_discipline.py)
-- ``jit-purity``       (jit_purity.py)
-- ``env-registry``     (env_registry.py)
+- ``rpc-conformance``      (rpc_conformance.py)
+- ``lock-discipline``      (lock_discipline.py)
+- ``jit-purity``           (jit_purity.py)
+- ``env-registry``         (env_registry.py)
+- ``fencing-conformance``  (fencing_conformance.py, interprocedural)
+- ``lock-order``           (lock_order.py, interprocedural)
+- ``abort-discipline``     (abort_discipline.py, interprocedural)
+
+The last three are the edl-verify layer: they run on the repo-wide
+call graph built by analysis/callgraph.py instead of one file at a
+time, so they can prove cross-file protocol invariants (fencing
+epochs threaded end to end, lock acquisition orders acyclic, handler
+exception paths classified).
 
 Findings support inline suppression with a mandatory reason::
 
@@ -48,11 +57,18 @@ RULE_FAMILIES = (
     "lock-discipline",
     "jit-purity",
     "env-registry",
+    "fencing-conformance",
+    "lock-order",
+    "abort-discipline",
 )
 
 #: internal families emitted by the core itself (always on, never
 #: suppressible: a broken suppression must not hide itself)
 CORE_FAMILIES = ("lint",)
+
+#: the interprocedural (edl-verify) families: baseline entries for
+#: these must carry a written reason (see load_baseline)
+VERIFY_FAMILIES = ("fencing-conformance", "lock-order", "abort-discipline")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -261,21 +277,41 @@ def load_context(root: str) -> AnalysisContext:
     return AnalysisContext(root, files)
 
 
-def _rule_runners():
+def _rule_modules():
     # local import: the rule modules import core for Finding
     from elasticdl_tpu.analysis import (
+        abort_discipline,
         env_registry,
+        fencing_conformance,
         jit_purity,
         lock_discipline,
+        lock_order,
         rpc_conformance,
     )
 
     return {
-        "rpc-conformance": rpc_conformance.run,
-        "lock-discipline": lock_discipline.run,
-        "jit-purity": jit_purity.run,
-        "env-registry": env_registry.run,
+        "rpc-conformance": rpc_conformance,
+        "lock-discipline": lock_discipline,
+        "jit-purity": jit_purity,
+        "env-registry": env_registry,
+        "fencing-conformance": fencing_conformance,
+        "lock-order": lock_order,
+        "abort-discipline": abort_discipline,
     }
+
+
+def _rule_runners():
+    return {name: mod.run for name, mod in _rule_modules().items()}
+
+
+def rule_descriptions() -> Dict[str, str]:
+    """{family: first docstring line} for --list-rules; derived from
+    the registered modules so the listing can't drift from the code."""
+    out = {}
+    for name, mod in _rule_modules().items():
+        doc = (mod.__doc__ or "").strip().splitlines()
+        out[name] = doc[0].split(":", 1)[-1].strip() if doc else ""
+    return out
 
 
 def run_analysis(
@@ -313,17 +349,47 @@ def run_analysis(
 
 
 def load_baseline(path: str) -> Dict[str, int]:
-    """baseline.json -> {finding key: accepted count}."""
+    """baseline.json -> {finding key: accepted count}.
+
+    Entries are either a bare key string or
+    ``{"key": ..., "comment": "<why this is accepted>"}`` — the
+    commented form is REQUIRED for the edl-verify families
+    (fencing-conformance, lock-order, abort-discipline): a protocol
+    violation parked in the baseline without a written reason is
+    indistinguishable from one nobody looked at."""
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
     counts: Dict[str, int] = {}
-    for key in data.get("findings", []):
+    for entry in data.get("findings", []):
+        if isinstance(entry, dict):
+            key = entry.get("key", "")
+            if not str(entry.get("comment", "")).strip():
+                raise ValueError(
+                    f"baseline entry for {key!r} has an empty comment"
+                )
+        else:
+            key = entry
+            rule = key.split("|", 1)[0]
+            if rule in VERIFY_FAMILIES:
+                raise ValueError(
+                    f"baseline entry {key!r} is a {rule} finding and "
+                    "must use the commented form "
+                    '{"key": ..., "comment": "<reason>"}'
+                )
         counts[key] = counts.get(key, 0) + 1
     return counts
 
 
 def save_baseline(path: str, findings: Sequence[Finding]) -> None:
-    keys = sorted(fi.key for fi in findings)
+    keys: List[object] = []
+    for key in sorted(fi.key for fi in findings):
+        if key.split("|", 1)[0] in VERIFY_FAMILIES:
+            # verify-family entries need a human-written reason; the
+            # placeholder keeps the file loadable but is meant to be
+            # replaced in review
+            keys.append({"key": key, "comment": "REVIEW: justify or fix"})
+        else:
+            keys.append(key)
     with open(path, "w", encoding="utf-8") as f:
         json.dump(
             {

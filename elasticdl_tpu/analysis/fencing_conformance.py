@@ -1,0 +1,401 @@
+"""fencing-conformance: the zombie-shard write hole, proven closed.
+
+The recovery plane (master/recovery.py) only works if the fencing
+protocol (rpc/fencing.py) is airtight END TO END: every shard-plane
+handler checks the request epoch before touching state, every client
+call to a shard-plane method stamps the epoch it knows, and a fenced
+rejection surfaces as FAILED_PRECONDITION that the retry layer does
+NOT retry. Any single gap silently reopens the hole — a zombie shard
+applies a stale write, or a client hammers a fenced shard until the
+deadline. This rule cross-references all three sides statically.
+
+A class is a *fenced servicer* when any handler it registers (via a
+``handlers()`` table or an inline ``RpcServer({...})``) reaches the
+fence check — a call to ``check_epoch`` (rpc/fencing.py), directly or
+through a same-class helper like ``_check_epoch``. Once one handler is
+fenced, ALL of the class's registered handlers must be, except those
+the class explicitly declares in a class-level
+``UNFENCED_HANDLERS = frozenset({...})`` (shard<->shard control
+traffic addressed by the group, e.g. the KV mirror plane).
+
+Checks:
+
+- ``unfenced-handler``      registered handler of a fenced servicer
+                            never reaches the fence check
+- ``fence-after-mutation``  the fence check runs after a write to self
+                            state (the stale write already landed)
+- ``unfenced-call-site``    client call to a fenced shard method whose
+                            request neither carries a literal
+                            ``"epoch"`` key nor goes through a
+                            ``_stamp_epoch`` wrapper
+- ``declared-unfenced-stale``  UNFENCED_HANDLERS names a method the
+                            class does not register
+- ``stamp-helper-inert``    a ``_stamp_epoch`` helper that never sets
+                            ``req["epoch"]``
+- ``retryable-fenced-code`` FAILED_PRECONDITION crept into
+                            RETRYABLE_CODES (fenced errors would retry)
+- ``fenced-abort-missing``  no ``except EpochFencedError`` anywhere
+                            maps the fence rejection to a
+                            FAILED_PRECONDITION abort
+- ``fenced-abort-wrong-code``  the mapping aborts with a different code
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+from elasticdl_tpu.analysis.rpc_conformance import (
+    _collect_call_sites,
+    _collect_handlers,
+    _const_str,
+    _request_keys,
+    _DYNAMIC,
+)
+
+RULE = "fencing-conformance"
+
+
+def _calls_check_epoch(func: ast.AST) -> Optional[int]:
+    """Line of the first direct ``check_epoch(...)`` /
+    ``fencing.check_epoch(...)`` call inside `func`, else None."""
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None
+        )
+        if name == "check_epoch":
+            return node.lineno
+    return None
+
+
+def _fence_helpers(cls: ast.ClassDef) -> Set[str]:
+    """Method names of `cls` that directly call check_epoch."""
+    out = set()
+    for n in cls.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _calls_check_epoch(n) is not None:
+                out.add(n.name)
+    return out
+
+
+def _fence_line(func: ast.AST, helpers: Set[str]) -> Optional[int]:
+    """Line where `func` first reaches the fence: a direct check_epoch
+    call or a call to a same-class fence helper (``self._check_epoch``)."""
+    best = _calls_check_epoch(func)
+    for node in ast.walk(func):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        f = node.func
+        if (
+            isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+            and f.attr in helpers
+        ):
+            if best is None or node.lineno < best:
+                best = node.lineno
+    return best
+
+
+_MUTATING_METHODS = {
+    "append", "add", "update", "pop", "setdefault", "clear",
+    "extend", "remove", "discard", "popleft", "appendleft",
+}
+
+
+def _first_mutation_line(func: ast.AST) -> Optional[int]:
+    """Line of the first direct write to self state in `func`:
+    ``self.x = / +=``, ``self.x[...] =``, or a mutating container
+    method on a self attribute. Helper-mediated mutations are the
+    helpers' concern (they assert the caller fenced)."""
+    best: Optional[int] = None
+
+    def consider(line: int) -> None:
+        nonlocal best
+        if best is None or line < best:
+            best = line
+
+    for node in ast.walk(func):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            base = t.value if isinstance(t, ast.Subscript) else t
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                consider(node.lineno)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATING_METHODS
+            and isinstance(node.func.value, ast.Attribute)
+            and isinstance(node.func.value.value, ast.Name)
+            and node.func.value.value.id == "self"
+        ):
+            consider(node.lineno)
+    return best
+
+
+def _declared_unfenced(cls: ast.ClassDef) -> Tuple[Set[str], Optional[int]]:
+    """(names, line) of a class-level UNFENCED_HANDLERS declaration."""
+    for n in cls.body:
+        if not (isinstance(n, ast.Assign) and len(n.targets) == 1):
+            continue
+        t = n.targets[0]
+        if not (isinstance(t, ast.Name) and t.id == "UNFENCED_HANDLERS"):
+            continue
+        names: Set[str] = set()
+        for node in ast.walk(n.value):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                names.add(node.value)
+        return names, n.lineno
+    return set(), None
+
+
+def _is_stamp_call(expr: Optional[ast.expr]) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    f = expr.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None
+    )
+    return name in ("_stamp_epoch", "stamp_epoch")
+
+
+def _threads_epoch(site) -> bool:
+    """Does the call site stamp a fencing epoch on its request?"""
+    if _is_stamp_call(site.request):
+        return True
+    # req = self._stamp_epoch({...}, i); c.call("M", req)
+    if isinstance(site.request, ast.Name) and site.func is not None:
+        name = site.request.id
+        for node in ast.walk(site.func):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            ):
+                if _is_stamp_call(node.value):
+                    return True
+    keys = _request_keys(site)
+    return keys is not _DYNAMIC and keys is not None and "epoch" in keys
+
+
+def _attr_tail(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _scan_abort_mapping(ctx: AnalysisContext, findings: List[Finding]) -> bool:
+    """Find ``except EpochFencedError`` handlers; flag ones that abort
+    with a code other than FAILED_PRECONDITION (and don't re-raise).
+    Returns True when at least one correct mapping exists."""
+    mapped = False
+    for path, tree in ctx.trees():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler) or node.type is None:
+                continue
+            names = {
+                _attr_tail(t)
+                for t in (
+                    node.type.elts
+                    if isinstance(node.type, ast.Tuple)
+                    else [node.type]
+                )
+            }
+            if "EpochFencedError" not in names:
+                continue
+            reraises = any(
+                isinstance(n, ast.Raise) for n in ast.walk(node)
+            )
+            codes = set()
+            for n in ast.walk(node):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "abort"
+                    and n.args
+                ):
+                    codes.add(_attr_tail(n.args[0]))
+            if "FAILED_PRECONDITION" in codes:
+                mapped = True
+            elif codes and not reraises:
+                findings.append(
+                    Finding(
+                        RULE, "fenced-abort-wrong-code", path, node.lineno,
+                        "except EpochFencedError aborts with "
+                        f"{sorted(c for c in codes if c)} — fenced rejections "
+                        "must map to FAILED_PRECONDITION so clients "
+                        "re-resolve instead of retrying",
+                    )
+                )
+            elif reraises:
+                mapped = True  # declared re-raise: an outer layer maps it
+    return mapped
+
+
+def _scan_retryable_codes(ctx: AnalysisContext, findings: List[Finding]) -> None:
+    for path, tree in ctx.trees():
+        for node in tree.body:
+            if not (
+                isinstance(node, (ast.Assign, ast.AnnAssign))
+            ):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            if not any(
+                isinstance(t, ast.Name) and t.id == "RETRYABLE_CODES"
+                for t in targets
+            ):
+                continue
+            value = node.value
+            if value is None:
+                continue
+            for n in ast.walk(value):
+                if _attr_tail(n) == "FAILED_PRECONDITION" and isinstance(
+                    n, (ast.Attribute, ast.Name)
+                ):
+                    findings.append(
+                        Finding(
+                            RULE, "retryable-fenced-code", path, node.lineno,
+                            "RETRYABLE_CODES contains FAILED_PRECONDITION — "
+                            "fenced/zombie rejections would be retried "
+                            "against a shard that will never accept them",
+                        )
+                    )
+                    break
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    handlers = _collect_handlers(ctx)
+
+    # fenced servicer classes and their registered methods
+    fenced_classes: Dict[Tuple[str, str], Set[str]] = {}
+    cls_helpers: Dict[Tuple[str, str], Set[str]] = {}
+    cls_unfenced: Dict[Tuple[str, str], Set[str]] = {}
+    by_class: Dict[Tuple[str, str], List] = {}
+    for h in handlers.values():
+        if h.cls is None or h.func is None:
+            continue
+        ckey = (h.path, h.cls.name)
+        by_class.setdefault(ckey, []).append(h)
+        if ckey not in cls_helpers:
+            cls_helpers[ckey] = _fence_helpers(h.cls)
+    for ckey, hs in by_class.items():
+        if any(
+            _fence_line(h.func, cls_helpers[ckey]) is not None for h in hs
+        ):
+            fenced_classes[ckey] = {h.method for h in hs}
+            declared, decl_line = _declared_unfenced(hs[0].cls)
+            cls_unfenced[ckey] = declared
+            for name in sorted(declared - fenced_classes[ckey]):
+                findings.append(
+                    Finding(
+                        RULE, "declared-unfenced-stale", ckey[0],
+                        decl_line or hs[0].cls.lineno,
+                        f"{ckey[1]}.UNFENCED_HANDLERS lists {name!r}, "
+                        "which the class does not register",
+                    )
+                )
+
+    # handler side: every registered method of a fenced servicer checks
+    # the epoch before mutating, unless declared unfenced
+    fenced_methods: Set[str] = set()
+    for ckey, methods in fenced_classes.items():
+        declared = cls_unfenced[ckey]
+        fenced_methods |= methods - declared
+        for h in by_class[ckey]:
+            if h.method in declared:
+                continue
+            fence = _fence_line(h.func, cls_helpers[ckey])
+            if fence is None:
+                findings.append(
+                    Finding(
+                        RULE, "unfenced-handler", h.path, h.func.lineno,
+                        f"shard handler {h.method} ({ckey[1]}.{h.func.name}) "
+                        "never invokes the fencing check — a zombie shard "
+                        "would apply stale-epoch requests (declare it in "
+                        "UNFENCED_HANDLERS if that is by design)",
+                    )
+                )
+                continue
+            mutation = _first_mutation_line(h.func)
+            if mutation is not None and mutation < fence:
+                findings.append(
+                    Finding(
+                        RULE, "fence-after-mutation", h.path, mutation,
+                        f"shard handler {h.method} ({ckey[1]}.{h.func.name}) "
+                        "writes self state before the fencing check — the "
+                        "stale write lands before the epoch is validated",
+                    )
+                )
+
+    # client side: every call to a fenced method threads an epoch
+    for site in _collect_call_sites(ctx):
+        if site.method not in fenced_methods:
+            continue
+        if not _threads_epoch(site):
+            findings.append(
+                Finding(
+                    RULE, "unfenced-call-site", site.path, site.line,
+                    f"call to fenced shard RPC {site.method} threads no "
+                    "fencing epoch (no literal 'epoch' key and no "
+                    "_stamp_epoch wrapper) — after a shard relaunch this "
+                    "client would keep writing to the new generation "
+                    "unfenced",
+                )
+            )
+
+    # every _stamp_epoch helper must actually set req["epoch"]
+    for path, tree in ctx.trees():
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in ("_stamp_epoch", "stamp_epoch")
+            ):
+                continue
+            sets_epoch = any(
+                isinstance(n, ast.Subscript)
+                and isinstance(n.ctx, ast.Store)
+                and _const_str(n.slice) == "epoch"
+                for n in ast.walk(node)
+            )
+            if not sets_epoch:
+                findings.append(
+                    Finding(
+                        RULE, "stamp-helper-inert", path, node.lineno,
+                        f"{node.name} never assigns req['epoch'] — every "
+                        "call site routed through it is silently unfenced",
+                    )
+                )
+
+    # wire protocol: fenced rejection -> FAILED_PRECONDITION, never retried
+    if fenced_methods:
+        mapped = _scan_abort_mapping(ctx, findings)
+        if not mapped:
+            # attribute to the first fenced servicer class (stable)
+            ckey = sorted(fenced_classes)[0]
+            findings.append(
+                Finding(
+                    RULE, "fenced-abort-missing", ckey[0],
+                    by_class[ckey][0].cls.lineno,
+                    "no except EpochFencedError handler maps the fence "
+                    "rejection to a FAILED_PRECONDITION abort — fenced "
+                    "writes would surface as INTERNAL and retry policy "
+                    "cannot distinguish them",
+                )
+            )
+    _scan_retryable_codes(ctx, findings)
+    return findings

@@ -1,0 +1,178 @@
+"""lock-order: interprocedural deadlock and blocking analysis.
+
+lock_discipline sees one function at a time, so two whole classes of
+concurrency bugs are invisible to it: (1) lock-order inversions —
+thread A holds L1 and calls into code that takes L2 while thread B
+does the reverse; with ~18 Lock() holders across master/rpc/worker the
+orderings only exist ACROSS methods; (2) blocking operations reached
+through calls — an RPC `.result()` three frames below a held servicer
+lock stalls every other handler exactly like a direct `time.sleep`
+under the lock, but no single-function scan can see it.
+
+This rule builds the repo call graph (analysis/callgraph.py), computes
+for every function the set of locks it may transitively acquire, and
+derives the lock-acquisition-order graph: an edge A -> B means some
+code path acquires B while holding A (directly nested `with`, or
+through any resolved call chain). Checks:
+
+- ``lock-cycle``      a cycle in the acquisition-order graph — two
+                      threads interleaving those paths can deadlock
+- ``self-deadlock``   a path re-acquires a NON-reentrant lock it
+                      already holds (guaranteed deadlock, not a race)
+- ``blocking-call-chain``  a call made under a held lock reaches a
+                      blocking operation (RPC .call / .result / .join /
+                      .wait / time.sleep) in a callee; the direct,
+                      same-frame case stays lock_discipline's
+                      ``blocking-under-lock``
+
+All messages name locks as ``Class.attr``; findings are suppressible
+with the usual ``# edl-lint: disable=lock-order -- reason`` where the
+order or the blocking is deliberate (e.g. a ride-through that pauses
+the control plane on purpose).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from elasticdl_tpu.analysis.callgraph import CallGraph, FuncKey, LockId
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+
+RULE = "lock-order"
+
+
+def _lock_edges(
+    g: CallGraph,
+) -> Dict[Tuple[LockId, LockId], Tuple[str, int, str]]:
+    """(held, acquired) -> one representative (path, line, via) site.
+    The representative is the lexicographically-first site so reruns
+    are deterministic."""
+    edges: Dict[Tuple[LockId, LockId], Tuple[str, int, str]] = {}
+
+    def note(a: LockId, b: LockId, path: str, line: int, via: str) -> None:
+        cur = edges.get((a, b))
+        site = (path, line, via)
+        if cur is None or site < cur:
+            edges[(a, b)] = site
+
+    for key, func in g.functions.items():
+        for acq in g.acquires.get(key, []):
+            for held in acq.held:
+                if held != acq.lock:
+                    note(held, acq.lock, func.path, acq.line, func.qualname)
+        for edge in g.edges.get(key, []):
+            if not edge.held:
+                continue
+            callee = g.functions[edge.callee]
+            for b in g.transitive_acquires(edge.callee):
+                for a in edge.held:
+                    if a != b:
+                        note(
+                            a, b, func.path, edge.line,
+                            f"{func.qualname} -> {callee.qualname}",
+                        )
+    return edges
+
+
+def _find_cycles(
+    edges: Dict[Tuple[LockId, LockId], Tuple[str, int, str]],
+) -> List[List[LockId]]:
+    """Elementary cycles in the (small) lock graph, deduplicated by
+    rotation so each cycle reports once, from its smallest lock."""
+    adj: Dict[LockId, Set[LockId]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles: Dict[Tuple[LockId, ...], List[LockId]] = {}
+
+    def dfs(start: LockId, cur: LockId, path: List[LockId], seen: Set[LockId]):
+        for nxt in sorted(adj.get(cur, ())):
+            if nxt == start:
+                rot = min(range(len(path)), key=lambda i: path[i])
+                canon = tuple(path[rot:] + path[:rot])
+                cycles.setdefault(canon, list(canon))
+            elif nxt not in seen and nxt > start:
+                # only expand locks > start: each cycle found exactly
+                # once, from its smallest member
+                dfs(start, nxt, path + [nxt], seen | {nxt})
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return [cycles[k] for k in sorted(cycles)]
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    g = CallGraph(ctx)
+    findings: List[Finding] = []
+
+    # self-deadlock: re-acquiring a held non-reentrant lock
+    for key, func in g.functions.items():
+        for acq in g.acquires.get(key, []):
+            if acq.lock in acq.held and g.lock_kinds.get(acq.lock) != "RLock":
+                findings.append(
+                    Finding(
+                        RULE, "self-deadlock", func.path, acq.line,
+                        f"{func.qualname} re-acquires non-reentrant lock "
+                        f"{g.lock_name(acq.lock)} already held on this "
+                        "path — guaranteed deadlock",
+                    )
+                )
+        for edge in g.edges.get(key, []):
+            hit = set(edge.held) & g.transitive_acquires(edge.callee)
+            for lock in sorted(hit):
+                if g.lock_kinds.get(lock) == "RLock":
+                    continue
+                callee = g.functions[edge.callee]
+                findings.append(
+                    Finding(
+                        RULE, "self-deadlock", func.path, edge.line,
+                        f"{func.qualname} holds non-reentrant lock "
+                        f"{g.lock_name(lock)} and calls "
+                        f"{callee.qualname}, which can re-acquire it — "
+                        "guaranteed deadlock on that path",
+                    )
+                )
+
+    # lock-order cycles
+    edges = _lock_edges(g)
+    for cycle in _find_cycles(edges):
+        names = [g.lock_name(lk) for lk in cycle]
+        ring = " -> ".join(names + [names[0]])
+        sites = []
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            path, line, via = edges[(a, b)]
+            sites.append((path, line, f"{via} takes {g.lock_name(b)}"))
+        sites.sort()
+        path, line, _ = sites[0]
+        detail = "; ".join(s[2] for s in sites)
+        findings.append(
+            Finding(
+                RULE, "lock-cycle", path, line,
+                f"lock-order cycle {ring}: {detail} — threads "
+                "interleaving these paths can deadlock",
+            )
+        )
+
+    # blocking reached through a call while a lock is held
+    for key, func in g.functions.items():
+        reported: Set[Tuple[int, FuncKey]] = set()
+        for edge in g.edges.get(key, []):
+            if not edge.held or not g.may_block(edge.callee):
+                continue
+            if (edge.line, edge.callee) in reported:
+                continue
+            reported.add((edge.line, edge.callee))
+            chain = g.blocking_chain(edge.callee)
+            chain_s = " -> ".join(chain) if chain else "?"
+            locks = ", ".join(
+                sorted(g.lock_name(lk) for lk in edge.held)
+            )
+            findings.append(
+                Finding(
+                    RULE, "blocking-call-chain", func.path, edge.line,
+                    f"{func.qualname} holds {locks} across a call that "
+                    f"reaches a blocking operation: {chain_s} — every "
+                    "thread contending for the lock stalls behind it",
+                )
+            )
+    return findings

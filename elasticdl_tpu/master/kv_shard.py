@@ -78,6 +78,16 @@ def arrays_to_snapshot(
 class KVShardServicer:
     """One shard's RPC surface over a local EmbeddingStore."""
 
+    # The mirror plane carries no fencing epoch: it is shard<->shard /
+    # group->shard control traffic addressed by the group, which always
+    # talks to the generation it just launched. Declared here so
+    # edl-verify (analysis/fencing_conformance.py) can prove every
+    # OTHER handler and call site threads an epoch — an undeclared
+    # unfenced RPC is a finding, a declared-but-unregistered one too.
+    UNFENCED_HANDLERS = frozenset(
+        {"KVMirror", "KVMirrorSnapshot", "KVSetMirror"}
+    )
+
     def __init__(self, shard_id: int, num_shards: int, generation: int = 0):
         self.shard_id = int(shard_id)
         self.num_shards = int(num_shards)

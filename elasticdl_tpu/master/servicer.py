@@ -800,7 +800,7 @@ class MasterServicer:
         if hook is not None and loss is not None:
             try:
                 hook(version, float(loss))
-            except Exception:  # a metrics sink must never fail training
+            except Exception:  # edl-lint: disable=abort-discipline -- a metrics sink must never fail training; the hook call is the last statement, so nothing downstream depends on it
                 logger.exception("train-loss hook failed")
 
     def _opt_state_snapshot(self):

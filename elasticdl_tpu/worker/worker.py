@@ -313,8 +313,10 @@ class Worker:
             # sharded PS: assemble the model from all shards in parallel;
             # per-shard only_if_newer makes the steady-state refresh
             # proportional to what actually advanced
+            with self._report_lock:
+                known_versions = self._shard_versions
             versions, vec = self._ps.pull(
-                versions=self._shard_versions,
+                versions=known_versions,
                 model_dtype=(
                     "bfloat16"
                     if self._transport_dtype == "bfloat16"
@@ -347,12 +349,13 @@ class Worker:
                         list(versions),
                         np.asarray(vec, dtype=np.float32).copy(),
                     )
-            self._fresh = True
+                self._fresh = True
             return True
         req = {"version": min_version, "method": method}
         if method == MethodType.MINIMUM:
             req["only_if_newer"] = True
-            req["version"] = self._version
+            with self._report_lock:
+                req["version"] = self._version
             if use_flat:
                 req["flat"] = True
         resp = self._master.call("GetModel", req)
@@ -374,13 +377,13 @@ class Worker:
                 from elasticdl_tpu.common import codec
 
                 self._flat = jnp.asarray(codec.ravel_np(resp["params"]))
-        self._version = resp["version"]
-        if method == MethodType.MINIMUM:
-            with self._report_lock:
+        with self._report_lock:
+            self._version = resp["version"]
+            if method == MethodType.MINIMUM:
                 self._lineage_version = self._version
                 self._shard_lineage = None
                 self._lineage_anchor_abs = self._own_steps_abs
-            self._fresh = True
+                self._fresh = True
         return True
 
     # -------------------------------------------------- flat-transport state
@@ -442,7 +445,8 @@ class Worker:
             (grads, aux_state or None, loss)
         )
         if version is None:
-            version = self._version
+            with self._report_lock:
+                version = self._version
         if flat and self._ensure_ps() is not None:
             # sharded PS per-step path (async/windowed-sync shards —
             # strict-equality sync is refused at master boot): gradient
@@ -922,13 +926,17 @@ class Worker:
             # overlap the next window's h2d + compute (pipeline)
             self._check_sync_error()
             self._absorb_sync_result()
+        with self._report_lock:
+            fresh, version = self._fresh, self._version
         if self._pending_steps == 0 and (
-            not self._fresh or self._version < task.model_version
+            not fresh or version < task.model_version
         ):
             with self.timers.phase("sync_wait"):
                 self._join_sync()  # model swap: settle the chain first
-            if not self._fresh or self._version < task.model_version:
-                if not self.pull_model(max(self._version, task.model_version)):
+            with self._report_lock:  # re-read: the joined sync may have
+                fresh, version = self._fresh, self._version  # rebased us
+            if not fresh or version < task.model_version:
+                if not self.pull_model(max(version, task.model_version)):
                     self._lazy_init_model(features)
                 self._opt_state = None  # params swapped: restart opt state
         if self._opt_state is None:
@@ -936,7 +944,8 @@ class Worker:
                 tx = self._spec.optimizer()
                 self._opt_state = tx.init(self._flat)
                 self._base_flat = jnp.copy(self._flat)
-                self._base_version = self._version
+                with self._report_lock:
+                    self._base_version = self._version
 
     def _local_minibatch(self, features, labels, task: Task, embs=None):
         self._ensure_local_ready(features, task)
@@ -1712,11 +1721,11 @@ class Worker:
         flat-transport template is known). Used by both the serial
         retry loop and the pipelined path — the handshake must never
         fork."""
-        if not self._fresh or self._version < task.model_version:
+        with self._report_lock:
+            fresh, version = self._fresh, self._version
+        if not fresh or version < task.model_version:
             with self.timers.phase("get_model"):
-                pulled = self.pull_model(
-                    max(self._version, task.model_version)
-                )
+                pulled = self.pull_model(max(version, task.model_version))
             if not pulled:
                 self._lazy_init_model(features)
         if self._train_step is None:
@@ -1781,7 +1790,9 @@ class Worker:
         rides each report so that accounting stays honest; a rejection
         (staleness outran the window — other workers advanced) falls
         back to the serial retry loop for that batch at the join."""
-        if not self._fresh or self._version < task.model_version:
+        with self._report_lock:
+            fresh, version = self._fresh, self._version
+        if not fresh or version < task.model_version:
             # drain first: an in-flight response may carry the refresh
             self._join_step_pipeline(task)
         self._ensure_step_ready(features, task)
@@ -1792,8 +1803,8 @@ class Worker:
         loss, gparams, _gbets, new_aux = step(
             self._step_params(), self._aux, embs, features, labels
         )
-        compute_version = self._version
         with self._report_lock:
+            compute_version = self._version
             shard_base = (
                 list(self._shard_versions) if self._shard_versions else None
             )
@@ -1866,19 +1877,20 @@ class Worker:
         with pipelined reports completing out of order) must not roll
         the local params back."""
         v = resp["version"]
-        if (
-            resp.get("params_flat") is not None
-            and self._use_flat()
-            and v > self._version
-        ):
-            self._set_flat(resp["params_flat"], resp.get("aux"))
-            self._version = v
-            self._fresh = True
-        elif v == self._version:
-            self._fresh = True  # nothing applied yet; still current
-        elif v > self._version:
-            self._fresh = False  # master ran ahead without a piggyback
-        # v < self._version: late out-of-order response; local is newer
+        with self._report_lock:
+            if (
+                resp.get("params_flat") is not None
+                and self._use_flat()
+                and v > self._version
+            ):
+                self._set_flat(resp["params_flat"], resp.get("aux"))
+                self._version = v
+                self._fresh = True
+            elif v == self._version:
+                self._fresh = True  # nothing applied yet; still current
+            elif v > self._version:
+                self._fresh = False  # master ran ahead w/o a piggyback
+            # v < self._version: late out-of-order response; keep local
 
     def _ragged_train_step(self):
         """Uncached single-device fallback for batches not divisible by
@@ -1957,12 +1969,14 @@ class Worker:
             with self.timers.phase("device_wait"):
                 self.last_loss = float(loss)
             self.task_losses.append(self.last_loss)
+            with self._report_lock:
+                version = self._version
             logger.info(
                 "Worker %d task %d done (last loss %.4f, v%d) [%s]",
                 self._id,
                 task.task_id,
                 self.last_loss,
-                self._version,
+                version,
                 self.timers.summary(),
             )
         return deferred
@@ -1970,7 +1984,11 @@ class Worker:
     def _process_evaluation_task(self, task: Task):
         """Version-pinned eval (reference: worker.py:354-358, FIXED pull
         served from the eval snapshot, servicer.py:128-139)."""
-        saved = (self._params, self._aux, self._version, self._flat, self._fresh)
+        # model state (_params/_aux/_flat) is main-thread-only; the
+        # counters (_version/_fresh) are shared with sync threads
+        saved_model = (self._params, self._aux, self._flat)
+        with self._report_lock:
+            saved_counters = (self._version, self._fresh)
         try:
             self.pull_model(task.model_version, MethodType.FIXED)
             if self._eval_step is None:
@@ -2014,13 +2032,9 @@ class Worker:
                     },
                 )
         finally:
-            (
-                self._params,
-                self._aux,
-                self._version,
-                self._flat,
-                self._fresh,
-            ) = saved
+            (self._params, self._aux, self._flat) = saved_model
+            with self._report_lock:
+                (self._version, self._fresh) = saved_counters
 
     def _ragged_eval_step(self):
         if not hasattr(self, "_ragged_eval"):
@@ -2267,7 +2281,7 @@ class Worker:
             )
             self._standby_warmed = True  # do not retry-loop a hard failure
 
-    def _finalize_local_updates(self):
+    def _finalize_local_updates(self):  # edl-lint: disable=lock-discipline -- runs after _join_sync()/blocking sync: no sync thread is alive to race the _version read at the loss-record line
         """Drain local-update state before exit: join the in-flight
         async sync, push any unsynced window, flush deferred reports.
         Without this the final window's delta rides a daemon thread and

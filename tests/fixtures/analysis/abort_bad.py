@@ -1,0 +1,38 @@
+"""abort-discipline positive fixture: `_run`, two frames below the
+registered Work handler, swallows Exception (a chaos fault dies there
+instead of reaching the server's classifier) and `_fenced` eats
+EpochFencedError outright (the fencing protocol silently defeated).
+Loaded as source by tests/test_static_analysis.py; never imported."""
+
+
+class EpochFencedError(Exception):
+    pass
+
+
+class Servicer:
+    def __init__(self):
+        self.errors = 0
+
+    def handlers(self):
+        return {"Work": self.work}
+
+    def work(self, req):
+        self._fenced(req)
+        return self._run(req)
+
+    def _run(self, req):
+        try:
+            return {"out": req["x"] * 2}
+        except Exception:
+            self.errors += 1
+            return {}
+
+    def _fenced(self, req):
+        try:
+            req.setdefault("epoch", 0)
+        except EpochFencedError:
+            self.errors += 1
+
+
+def go(client):
+    client.call("Work", {"x": 1})

@@ -1,0 +1,37 @@
+"""Clean twin for abort-discipline: every except on the handler's call
+path either re-raises (the server's classifier maps it) or aborts with
+a classified code itself. Loaded as source by
+tests/test_static_analysis.py; never imported."""
+
+
+class StatusCode:
+    INTERNAL = "internal"
+
+
+class Servicer:
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self.errors = 0
+
+    def handlers(self):
+        return {"Work": self.work}
+
+    def work(self, req):
+        return self._run(req)
+
+    def _run(self, req):
+        try:
+            return {"out": req["x"] * 2}
+        except Exception:
+            self.errors += 1
+            raise
+
+    def classify(self, exc):
+        try:
+            raise exc
+        except Exception as e:
+            self._ctx.abort(StatusCode.INTERNAL, str(e))
+
+
+def go(client):
+    client.call("Work", {"x": 1})

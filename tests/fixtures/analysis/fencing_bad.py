@@ -1,0 +1,43 @@
+"""fencing-conformance positive fixture: `put` is a registered handler
+of a fenced servicer (its sibling `get` reaches check_epoch) but never
+fences — a zombie shard would apply its stale write — and the `Get`
+call site threads no epoch. Loaded as source by
+tests/test_static_analysis.py; never imported."""
+
+
+class EpochFencedError(Exception):
+    pass
+
+
+def check_epoch(req, generation):
+    if req.get("epoch") != generation:
+        raise EpochFencedError(req.get("epoch"))
+
+
+class ShardServicer:
+    def __init__(self):
+        self.generation = 0
+        self.rows = {}
+
+    def handlers(self):
+        return {"Get": self.get, "Put": self.put}
+
+    def _check_epoch(self, req):
+        check_epoch(req, self.generation)
+
+    def get(self, req):
+        self._check_epoch(req)
+        return {"value": self.rows.get(req["key"])}
+
+    def put(self, req):  # unfenced: mutates state with no epoch check
+        self.rows[req["key"]] = req["value"]
+        return {}
+
+
+def write(client):
+    client.call("Put", {"key": "k", "value": 1, "epoch": 3})
+
+
+def read(client):
+    # epoch-less call to a fenced shard RPC
+    client.call("Get", {"key": "k"})

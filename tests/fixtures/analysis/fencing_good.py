@@ -1,0 +1,65 @@
+"""Clean twin for fencing-conformance: every handler fences before
+mutating, every call site threads an epoch (literal key or a
+_stamp_epoch wrapper), and the fence rejection maps to
+FAILED_PRECONDITION. Loaded as source by tests/test_static_analysis.py;
+never imported."""
+
+
+class EpochFencedError(Exception):
+    pass
+
+
+class StatusCode:
+    FAILED_PRECONDITION = "failed-precondition"
+
+
+def check_epoch(req, generation):
+    if req.get("epoch") != generation:
+        raise EpochFencedError(req.get("epoch"))
+
+
+class ShardServicer:
+    def __init__(self):
+        self.generation = 0
+        self.rows = {}
+
+    def handlers(self):
+        return {"Get": self.get, "Put": self.put}
+
+    def _check_epoch(self, req):
+        check_epoch(req, self.generation)
+
+    def get(self, req):
+        self._check_epoch(req)
+        return {"value": self.rows.get(req["key"])}
+
+    def put(self, req):
+        self._check_epoch(req)
+        self.rows[req["key"]] = req["value"]
+        return {}
+
+
+class ShardClient:
+    def __init__(self, client, epoch):
+        self._client = client
+        self._epoch = epoch
+
+    def _stamp_epoch(self, req):
+        req["epoch"] = self._epoch
+        return req
+
+    def put(self, key, value):
+        self._client.call(
+            "Put", self._stamp_epoch({"key": key, "value": value})
+        )
+
+
+def read(client, epoch):
+    client.call("Get", {"key": "k", "epoch": epoch})
+
+
+def serve(servicer, req, ctx):
+    try:
+        return servicer.get(req)
+    except EpochFencedError as e:
+        ctx.abort(StatusCode.FAILED_PRECONDITION, str(e))

@@ -1,0 +1,42 @@
+"""lock-order positive fixture: `forward` takes a then (via a callee)
+b while `backward` takes b then (via a callee) a — a cross-call
+inversion no single-function scan can see; `stall` holds a across a
+call that reaches time.sleep; `re_enter` re-acquires a non-reentrant
+lock through a callee. Loaded as source by
+tests/test_static_analysis.py; never imported."""
+
+import threading
+import time
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def _take_a(self):
+        with self._a:
+            return 1
+
+    def _take_b(self):
+        with self._b:
+            return 2
+
+    def forward(self):
+        with self._a:
+            return self._take_b()
+
+    def backward(self):
+        with self._b:
+            return self._take_a()
+
+    def _slow(self):
+        time.sleep(0.1)
+
+    def stall(self):
+        with self._a:
+            self._slow()
+
+    def re_enter(self):
+        with self._a:
+            return self._take_a()

@@ -1,0 +1,34 @@
+"""Clean twin for lock-order: both nested acquisitions take the locks
+in the same a-then-b order (no cycle), and the only re-entrant path
+goes through an RLock. Loaded as source by
+tests/test_static_analysis.py; never imported."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._r = threading.RLock()
+
+    def _take_b(self):
+        with self._b:
+            return 1
+
+    def forward(self):
+        with self._a:
+            return self._take_b()
+
+    def also_forward(self):
+        with self._a:
+            with self._b:
+                return 2
+
+    def _locked_r(self):
+        with self._r:
+            return 3
+
+    def re_enter_ok(self):
+        with self._r:
+            return self._locked_r()

@@ -7,6 +7,7 @@ the lint exists to hold (every called method has a handler, the retry
 classification matches rpc/policy.py, the live tree is lint-clean).
 """
 
+import ast
 import json
 import os
 import subprocess
@@ -16,11 +17,21 @@ import pytest
 
 from elasticdl_tpu.analysis import RULE_FAMILIES, run_analysis
 from elasticdl_tpu.analysis.__main__ import main as lint_main
-from elasticdl_tpu.analysis.core import load_context
+from elasticdl_tpu.analysis.core import load_baseline, load_context
+from elasticdl_tpu.analysis import abort_discipline as ad
+from elasticdl_tpu.analysis import callgraph as cg
+from elasticdl_tpu.analysis import fencing_conformance as fc
+from elasticdl_tpu.analysis import lock_order as lo
 from elasticdl_tpu.analysis import rpc_conformance as rc
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG_ROOT = os.path.join(REPO_ROOT, "elasticdl_tpu")
+FIXTURE_DIR = os.path.join(REPO_ROOT, "tests", "fixtures", "analysis")
+
+
+def _fixture(name):
+    with open(os.path.join(FIXTURE_DIR, name), encoding="utf-8") as f:
+        return f.read()
 
 
 def _tree(tmp_path, files):
@@ -388,7 +399,251 @@ def test_env_registry_ignores_unprefixed(tmp_path):
     assert run_analysis(root, rules=["env-registry"]) == []
 
 
-# -- core: parse errors, baseline, CLI ---------------------------------------
+# -- edl-verify: fencing-conformance ------------------------------------------
+# the interprocedural families keep their fixtures as real files under
+# tests/fixtures/analysis/ (positive + clean twin per rule)
+
+FENCING_GOOD = _fixture("fencing_good.py")
+FENCING_BAD = _fixture("fencing_bad.py")
+LOCK_ORDER_GOOD = _fixture("lock_order_good.py")
+LOCK_ORDER_BAD = _fixture("lock_order_bad.py")
+ABORT_GOOD = _fixture("abort_good.py")
+ABORT_BAD = _fixture("abort_bad.py")
+
+
+def test_fencing_flags_unfenced_handler_and_call_site(tmp_path):
+    root = _tree(tmp_path, {"mod.py": FENCING_BAD})
+    checks = _checks(
+        run_analysis(root, rules=["fencing-conformance"]), "fencing-conformance"
+    )
+    assert "unfenced-handler" in checks  # put mutates with no epoch check
+    assert "unfenced-call-site" in checks  # Get called with no epoch
+    assert "fenced-abort-missing" in checks  # nothing maps the rejection
+
+
+def test_fencing_clean_under_all_rules(tmp_path):
+    # literal-epoch call, _stamp_epoch wrapper, helper-mediated fence,
+    # FAILED_PRECONDITION mapping: nothing to say, under any family
+    root = _tree(tmp_path, {"mod.py": FENCING_GOOD})
+    assert run_analysis(root) == []
+
+
+def test_fencing_fence_after_mutation(tmp_path):
+    src = FENCING_GOOD.replace(
+        '        self._check_epoch(req)\n'
+        '        self.rows[req["key"]] = req["value"]',
+        '        self.rows[req["key"]] = req["value"]\n'
+        '        self._check_epoch(req)',
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["fencing-conformance"]), "fencing-conformance"
+    )
+    assert "fence-after-mutation" in checks
+
+
+def test_fencing_declared_unfenced_exempts_handler(tmp_path):
+    src = FENCING_BAD.replace(
+        "    def handlers(self):",
+        '    UNFENCED_HANDLERS = frozenset({"Put"})\n\n'
+        "    def handlers(self):",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["fencing-conformance"]), "fencing-conformance"
+    )
+    assert "unfenced-handler" not in checks  # declared by-design unfenced
+    assert "unfenced-call-site" in checks  # the Get call site still fires
+
+
+def test_fencing_declared_unfenced_stale(tmp_path):
+    src = FENCING_GOOD.replace(
+        "    def handlers(self):",
+        '    UNFENCED_HANDLERS = frozenset({"Ghost"})\n\n'
+        "    def handlers(self):",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["fencing-conformance"]), "fencing-conformance"
+    )
+    assert "declared-unfenced-stale" in checks
+
+
+def test_fencing_stamp_helper_inert(tmp_path):
+    src = FENCING_GOOD.replace(
+        '        req["epoch"] = self._epoch\n        return req',
+        "        return req",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["fencing-conformance"]), "fencing-conformance"
+    )
+    assert "stamp-helper-inert" in checks
+
+
+def test_fencing_retryable_codes_guard(tmp_path):
+    src = FENCING_GOOD + (
+        "\n\nRETRYABLE_CODES = frozenset({StatusCode.FAILED_PRECONDITION})\n"
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["fencing-conformance"]), "fencing-conformance"
+    )
+    assert "retryable-fenced-code" in checks
+
+
+def test_fencing_wrong_abort_code(tmp_path):
+    src = FENCING_GOOD.replace(
+        "ctx.abort(StatusCode.FAILED_PRECONDITION, str(e))",
+        "ctx.abort(StatusCode.INTERNAL, str(e))",
+    ).replace(
+        '    FAILED_PRECONDITION = "failed-precondition"',
+        '    FAILED_PRECONDITION = "failed-precondition"\n'
+        '    INTERNAL = "internal"',
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["fencing-conformance"]), "fencing-conformance"
+    )
+    assert "fenced-abort-wrong-code" in checks
+
+
+# -- edl-verify: lock-order ----------------------------------------------------
+
+
+def test_lock_order_flags_cycle_blocking_and_self_deadlock(tmp_path):
+    root = _tree(tmp_path, {"mod.py": LOCK_ORDER_BAD})
+    findings = run_analysis(root, rules=["lock-order"])
+    checks = _checks(findings, "lock-order")
+    # a->b via forward's callee, b->a via backward's: only visible
+    # ACROSS the call boundary
+    assert "lock-cycle" in checks
+    assert "blocking-call-chain" in checks  # stall -> _slow -> time.sleep
+    assert "self-deadlock" in checks  # re_enter -> _take_a re-acquires _a
+    cycle = next(f for f in findings if f.check == "lock-cycle")
+    assert "Pair._a" in cycle.message and "Pair._b" in cycle.message
+
+
+def test_lock_order_clean_under_all_rules(tmp_path):
+    # consistent order + RLock re-entry: silent under every family
+    root = _tree(tmp_path, {"mod.py": LOCK_ORDER_GOOD})
+    assert run_analysis(root) == []
+
+
+def test_lock_order_direct_blocking_stays_lock_discipline(tmp_path):
+    # the same-frame sleep-under-lock is lock-discipline's finding; the
+    # interprocedural rule must not duplicate it
+    root = _tree(tmp_path, {"mod.py": LOCK_BAD})
+    assert run_analysis(root, rules=["lock-order"]) == []
+
+
+def test_find_cycles_canonical():
+    e = lambda *pairs: {p: ("m.py", 1, "via") for p in pairs}  # noqa: E731
+    a, b, c = ("m::C", "a"), ("m::C", "b"), ("m::C", "c")
+    assert lo._find_cycles(e((a, b), (b, a))) == [[a, b]]
+    # one rotation per cycle, reported from its smallest member
+    assert lo._find_cycles(e((b, c), (c, a), (a, b))) == [[a, b, c]]
+    assert lo._find_cycles(e((a, b), (b, c))) == []
+
+
+# -- edl-verify: abort-discipline ----------------------------------------------
+
+
+def test_abort_discipline_flags_swallowing_helpers(tmp_path):
+    root = _tree(tmp_path, {"mod.py": ABORT_BAD})
+    findings = run_analysis(root, rules=["abort-discipline"])
+    checks = _checks(findings, "abort-discipline")
+    assert "swallowed-exception" in checks  # _run eats Exception
+    assert "fence-swallowed" in checks  # _fenced eats EpochFencedError
+    # both attributed to the registering handler, two frames up
+    assert all("Work" in f.message for f in findings)
+
+
+def test_abort_discipline_clean_under_all_rules(tmp_path):
+    # re-raise and classified abort both discharge the obligation
+    root = _tree(tmp_path, {"mod.py": ABORT_GOOD})
+    assert run_analysis(root) == []
+
+
+def test_abort_discipline_ignores_unreachable_code(tmp_path):
+    # the same swallowing except outside any handler's call path is not
+    # this rule's concern
+    src = ABORT_BAD.replace('return {"Work": self.work}', "return {}")
+    src = src.replace('client.call("Work", {"x": 1})', "pass")
+    root = _tree(tmp_path, {"mod.py": src})
+    assert run_analysis(root, rules=["abort-discipline"]) == []
+
+
+def test_abort_discipline_suppression(tmp_path):
+    src = ABORT_BAD.replace(
+        "    def _run(self, req):",
+        "    def _run(self, req):  # edl-lint: disable=abort-discipline -- deliberate sink for the test",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["abort-discipline"]), "abort-discipline"
+    )
+    assert checks == {"fence-swallowed"}  # only the unsuppressed one
+
+
+# -- edl-verify: the call-graph engine -----------------------------------------
+
+
+def test_callgraph_resolution_and_lock_tracking(tmp_path):
+    src = """
+import threading
+import time
+
+
+def helper():
+    time.sleep(0.1)
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def leaf(self):
+        with self._lock:
+            return 1
+
+    def top(self):
+        with self._cv:
+            helper()
+        return self.leaf()
+"""
+    g = cg.CallGraph(load_context(_tree(tmp_path, {"mod.py": src})))
+    top = ("mod.py", "C", "top")
+    leaf = ("mod.py", "C", "leaf")
+    callees = {e.callee for e in g.edges[top]}
+    assert ("mod.py", None, "helper") in callees  # same-module call
+    assert leaf in callees  # self-method call
+    lock = ("mod.py::C", "_lock")
+    # Condition(self._lock) aliases to the lock it wraps
+    assert {a.lock for a in g.acquires[top]} == {lock}
+    assert g.transitive_acquires(top) == {lock}
+    assert g.may_block(top)  # via helper's time.sleep
+    assert g.blocking_chain(("mod.py", None, "helper")) == [
+        "helper", "time.sleep"
+    ]
+    assert g.lock_name(lock) == "C._lock"
+
+
+def test_callgraph_unresolvable_calls_make_no_edges(tmp_path):
+    src = """
+def f(obj):
+    obj.anything()
+    unknown_name()
+
+
+def unknown_name():
+    return 1
+"""
+    g = cg.CallGraph(load_context(_tree(tmp_path, {"mod.py": src})))
+    callees = {e.callee for e in g.edges.get(("mod.py", None, "f"), [])}
+    # obj.anything() is unresolvable -> dropped; the bare name resolves
+    assert callees == {("mod.py", None, "unknown_name")}
 
 
 def test_parse_error_is_a_finding(tmp_path):
@@ -411,6 +666,9 @@ def test_cli_rule_selection(tmp_path, rule):
         "lock-discipline": LOCK_BAD,
         "jit-purity": JIT_BAD,
         "env-registry": ENV_BAD,
+        "fencing-conformance": FENCING_BAD,
+        "lock-order": LOCK_ORDER_BAD,
+        "abort-discipline": ABORT_BAD,
     }
     root = _tree(tmp_path, {"mod.py": sources[rule]})
     assert lint_main(["--root", root, "--rule", rule, "--no-baseline"]) == 1
@@ -499,3 +757,120 @@ def test_repo_schemas_cover_handlers_exactly():
     ctx = load_context(PKG_ROOT)
     handlers = rc._collect_handlers(ctx)
     assert set(handlers) == set(WIRE_SCHEMAS)
+
+
+def test_repo_callgraph_sees_the_tree():
+    """The engine resolves the live tree at scale: hundreds of
+    functions, the worker's preamble edges, the Condition alias in the
+    recovery plane, and the worker's report lock."""
+    g = cg.CallGraph(load_context(PKG_ROOT))
+    assert len(g.functions) > 500
+    key = ("worker/worker.py", "Worker", "_ensure_local_ready")
+    callees = {e.callee[2] for e in g.edges[key]}
+    assert {"pull_model", "_join_sync"} <= callees
+    assert ("worker/worker.py::Worker", "_report_lock") in g.lock_kinds
+    # Condition(self._lock) in RecoveryPlane aliases to _lock: no
+    # phantom second lock, and its acquires resolve to the real one
+    assert ("master/recovery.py::RecoveryPlane", "_cv") not in g.lock_kinds
+    offer = ("master/recovery.py", "RecoveryPlane", "offer_upload")
+    assert {a.lock for a in g.acquires[offer]} == {
+        ("master/recovery.py::RecoveryPlane", "_lock")
+    }
+
+
+def test_repo_unfenced_declaration_matches_runtime():
+    """The AST-extracted UNFENCED_HANDLERS table IS the runtime one,
+    and only names methods the servicer actually registers — the same
+    cross-check style as the policy-set test above."""
+    from elasticdl_tpu.master.kv_shard import KVShardServicer
+
+    ctx = load_context(PKG_ROOT)
+    tree = ctx.files["master/kv_shard.py"].tree
+    cls = next(
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, ast.ClassDef) and n.name == "KVShardServicer"
+    )
+    declared, _line = fc._declared_unfenced(cls)
+    assert declared == set(KVShardServicer.UNFENCED_HANDLERS)
+    registered = {
+        h.method
+        for h in rc._collect_handlers(ctx).values()
+        if h.cls is not None and h.cls.name == "KVShardServicer"
+    }
+    assert declared < registered  # declared, registered, and not all
+
+
+def test_repo_handler_reachability_covers_helpers():
+    """Abort-discipline's walk reaches helpers several frames below a
+    registered handler (KVUpdate -> kv_update -> _enqueue_mirror)."""
+    ctx = load_context(PKG_ROOT)
+    g = cg.CallGraph(ctx)
+    roots = []
+    for h in rc._collect_handlers(ctx).values():
+        if h.func is None:
+            continue
+        key = (h.path, h.cls.name if h.cls else None, h.func.name)
+        if key in g.functions:
+            roots.append((key, h.method))
+    assert len(roots) > 20
+    reach = ad._handler_reachable(g, roots)
+    helper = ("master/kv_shard.py", "KVShardServicer", "_enqueue_mirror")
+    assert reach[helper] == "KVUpdate"
+
+
+# -- edl-verify CLI surface ----------------------------------------------------
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULE_FAMILIES:
+        assert rule in out
+
+
+def test_cli_github_format(tmp_path, capsys):
+    root = _tree(tmp_path, {"mod.py": LOCK_ORDER_BAD})
+    rc_code = lint_main(
+        ["--root", root, "--no-baseline", "--format", "github"]
+    )
+    assert rc_code == 1
+    lines = [
+        ln for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("::error ")
+    ]
+    assert lines
+    assert any("title=lock-order/lock-cycle" in ln for ln in lines)
+    assert all("file=" in ln and ",line=" in ln for ln in lines)
+
+
+def test_baseline_verify_families_require_comment(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    key = "lock-order|lock-cycle|mod.py|some cycle"
+    with open(path, "w") as f:
+        json.dump({"findings": [key]}, f)
+    with pytest.raises(ValueError, match="commented form"):
+        load_baseline(path)
+    with open(path, "w") as f:
+        json.dump({"findings": [{"key": key, "comment": "  "}]}, f)
+    with pytest.raises(ValueError, match="empty comment"):
+        load_baseline(path)
+    with open(path, "w") as f:
+        json.dump(
+            {"findings": [{"key": key, "comment": "reviewed: benign"}]}, f
+        )
+    assert load_baseline(path) == {key: 1}
+
+
+def test_write_baseline_emits_commented_verify_entries(tmp_path):
+    root = _tree(tmp_path, {"mod.py": LOCK_ORDER_BAD})
+    baseline = str(tmp_path / "baseline.json")
+    assert (
+        lint_main(["--root", root, "--write-baseline", "--baseline", baseline])
+        == 0
+    )
+    with open(baseline) as f:
+        entries = json.load(f)["findings"]
+    assert entries and all(isinstance(e, dict) for e in entries)
+    assert all(e["comment"] for e in entries)  # placeholder, but present
+    assert lint_main(["--root", root, "--baseline", baseline]) == 0
