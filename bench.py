@@ -61,6 +61,7 @@ def run_job(
     local_updates,
     grads_to_wait,
     transport_dtype="float32",
+    sync_dtype=None,
     staleness_window=0,
     step_pipeline=0,
     spec_overrides=None,
@@ -111,6 +112,7 @@ def run_job(
         local_updates=local_updates,
         transport_dtype=transport_dtype,
         step_pipeline=step_pipeline,
+        sync_dtype=sync_dtype,
     )
 
     # ---- untimed AOT warm-up: compile + one throwaway execution ----
@@ -128,12 +130,31 @@ def run_job(
         ps_opt.warmup(params)
 
     # ---- timed region: the steady-state training job ----
+    # wire-byte accounting covers exactly the timed region: the warm-up
+    # pulls and the compile-time report land before the reset
+    client.wire.reset()
     t0 = time.time()
     ok = worker.run()
     elapsed = time.time() - t0
+    wire = client.wire.snapshot()
     worker.close()
     server.stop()
     assert ok and dispatcher.finished() and not dispatcher.has_failed_tasks()
+    # bytes-per-sync for the mode's sync RPC (request = delta/grad up,
+    # response = merged/updated model down) — the number the bf16 sync
+    # plane halves; see rpc/policy.WireStats for what is counted
+    sync_method = "ReportLocalUpdate" if local_updates > 1 else "ReportGradient"
+    row = wire["methods"].get(sync_method) or {
+        "bytes_sent": 0, "bytes_received": 0, "calls": 0,
+    }
+    worker.wire_summary = {
+        "sync_method": sync_method,
+        "sync_calls": row["calls"],
+        "bytes_per_sync_up": row["bytes_sent"] // max(1, row["calls"]),
+        "bytes_per_sync_down": row["bytes_received"] // max(1, row["calls"]),
+        "bytes_sent_total": wire["bytes_sent"],
+        "bytes_received_total": wire["bytes_received"],
+    }
     return n_records * epochs / elapsed, worker, elapsed
 
 
@@ -248,10 +269,11 @@ def main():
             epochs=1,
             local_updates=window,
             grads_to_wait=1,
-            # bf16 deltas, cast on device: halves the per-window d2h
-            # bytes on the host<->TPU link (the bottleneck); the
-            # convergence gate below guards the quantization
-            transport_dtype="bfloat16",
+            # bf16 deltas with error feedback (the sync plane's lossy
+            # mode): halves the per-window d2h + wire bytes while the
+            # worker-held residual keeps the delta stream converging to
+            # the f32 trajectory; the convergence gate below guards it
+            sync_dtype="bfloat16",
         )
         # Convergence gate: the synthetic data is learnable
         # (class-dependent means), so the tail of the per-task loss
@@ -295,9 +317,13 @@ def main():
         per_image = worker.window_flops / (window * minibatch)
         tflops_per_sec = per_image * imgs_per_sec / 1e12
         mfu = tflops_per_sec / 197.0
+    wire = worker.wire_summary
     print(
         f"bench[window]: {n_records} imgs in {elapsed:.1f}s = "
         f"{imgs_per_sec:.1f} img/s; tail loss {tail:.3f}; "
+        f"{wire['bytes_per_sync_up']} B/sync up, "
+        f"{wire['bytes_per_sync_down']} B/sync down "
+        f"({wire['sync_calls']} syncs); "
         f"phases {worker.timers.summary()} "
         f"(accounted {100 * accounted / elapsed:.0f}% of wall)"
         + (
@@ -321,15 +347,17 @@ def main():
         epochs=1,
         local_updates=0,
         grads_to_wait=1,
-        # bf16 gradients, cast on device: halves the per-step d2h+wire
-        # bytes on the PS protocol's serial critical path
-        transport_dtype="bfloat16",
+        # bf16 gradients with error feedback: halves the per-step
+        # d2h+wire bytes on the PS protocol's serial critical path
+        sync_dtype="bfloat16",
         staleness_window=4,
         step_pipeline=4,
     )
     print(
         f"bench[per-step pipelined]: {per_step_records} imgs in "
         f"{ps_elapsed:.1f}s = {ps_imgs_per_sec:.1f} img/s; "
+        f"{ps_worker.wire_summary['bytes_per_sync_up']} B/step up, "
+        f"{ps_worker.wire_summary['bytes_per_sync_down']} B/step down; "
         f"phases {ps_worker.timers.summary()}",
         file=sys.stderr,
     )
@@ -343,7 +371,7 @@ def main():
         epochs=1,
         local_updates=0,
         grads_to_wait=1,
-        transport_dtype="bfloat16",
+        sync_dtype="bfloat16",
     )
     print(
         f"bench[per-step serial]: {per_step_records} imgs in "
@@ -436,6 +464,14 @@ def main():
                 "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
                 "per_step_images_per_sec": round(ps_imgs_per_sec, 1),
                 "per_step_serial_images_per_sec": round(ps_serial_imgs, 1),
+                # wire-byte accounting (rpc/policy.WireStats, timed
+                # region only): the window/per-step runs ride the bf16
+                # EF sync plane (--sync_dtype bf16), so bytes_per_sync
+                # here vs a float32 run is the codec win measured, not
+                # estimated
+                "window_wire": worker.wire_summary,
+                "per_step_wire": ps_worker.wire_summary,
+                "sync_dtype": "bfloat16",
                 "deepfm_sparse_window_records_per_sec": dfm_recs_per_sec,
                 "deepfm_bet_prefetch_ab": dfm_pair,
                 "resnet50_chip": resnet,

@@ -34,6 +34,15 @@ Checks:
                            same-class/module helpers)
 - ``schema-no-handler`` / ``handler-no-schema``  WIRE_SCHEMAS and the
                            registered handler set must match exactly
+- ``frame-emit-drift``     the codec v2 encoder's descriptor dict
+                           literal (``_frame_descriptor``) emits a key
+                           set different from the declared
+                           ``FRAME_DESCRIPTOR_FIELDS``
+- ``frame-read-drift``     the codec v2 decoder
+                           (``_read_frame_descriptor``) reads a
+                           descriptor key outside the declaration, or
+                           never reads a declared key — either way the
+                           wire contract and the code have diverged
 
 Request dicts are resolved from dict literals plus same-function
 dataflow (``req = {...}`` followed by ``req["k"] = v`` /
@@ -53,6 +62,11 @@ RULE = "rpc-conformance"
 #: request-field container types recognized as the wire contract
 _SCHEMA_MAP_NAME = "WIRE_SCHEMAS"
 _POLICY_SETS = ("IDEMPOTENT_METHODS", "DEDUP_KEYED_METHODS")
+#: codec v2 frame-descriptor contract (common/codec.py): declared key
+#: tuple plus the encoder/decoder functions checked against it
+_FRAME_FIELDS_NAME = "FRAME_DESCRIPTOR_FIELDS"
+_FRAME_ENCODER = "_frame_descriptor"
+_FRAME_DECODER = "_read_frame_descriptor"
 
 
 def _const_str(node) -> Optional[str]:
@@ -449,6 +463,109 @@ def _handler_key_reads(
     return reads
 
 
+# -- codec v2 frame-descriptor contract --------------------------------------
+
+
+def _frame_descriptor_findings(ctx: AnalysisContext) -> List[Finding]:
+    """Cross-check the v2 codec's descriptor dict against the declared
+    FRAME_DESCRIPTOR_FIELDS tuple, the same way WIRE_SCHEMAS pins
+    request dicts: the encoder's returned dict literal must emit
+    exactly the declared keys, and the decoder must read exactly them
+    (an unread declared key is dead wire weight; an undeclared read is
+    a decoder that depends on fields the contract doesn't promise)."""
+    findings: List[Finding] = []
+    declared: Optional[Set[str]] = None
+    decl_path, decl_line = None, 0
+    encoder = decoder = None
+    enc_path = dec_path = None
+    for path, tree in ctx.trees():
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == _FRAME_FIELDS_NAME
+            ):
+                fields = _str_set_from(node.value)
+                if fields is not None:
+                    declared, decl_path, decl_line = fields, path, node.lineno
+            if isinstance(node, ast.FunctionDef):
+                if node.name == _FRAME_ENCODER:
+                    encoder, enc_path = node, path
+                elif node.name == _FRAME_DECODER:
+                    decoder, dec_path = node, path
+    if declared is None:
+        return findings
+
+    if encoder is not None:
+        emitted: Set[str] = set()
+        emit_line = encoder.lineno
+        for node in ast.walk(encoder):
+            if not (
+                isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Dict)
+            ):
+                continue
+            emit_line = node.lineno
+            for k in node.value.keys:
+                s = _const_str(k)
+                if s is not None:
+                    emitted.add(s)
+        if emitted and emitted != declared:
+            findings.append(
+                Finding(
+                    RULE, "frame-emit-drift", enc_path, emit_line,
+                    f"{_FRAME_ENCODER} emits descriptor keys "
+                    f"{sorted(emitted)} but {_FRAME_FIELDS_NAME} declares "
+                    f"{sorted(declared)} — update the declaration (and "
+                    f"the decoder) with the contract change",
+                )
+            )
+
+    if decoder is not None and decoder.args.args:
+        param = decoder.args.args[0].arg
+        reads: Set[str] = set()
+        read_lines: Dict[str, int] = {}
+        for node in ast.walk(decoder):
+            key = None
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == param
+            ):
+                key = _const_str(node.slice)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == param
+                and node.args
+            ):
+                key = _const_str(node.args[0])
+            if key is not None:
+                reads.add(key)
+                read_lines.setdefault(key, node.lineno)
+        for key in sorted(reads - declared):
+            findings.append(
+                Finding(
+                    RULE, "frame-read-drift", dec_path, read_lines[key],
+                    f"{_FRAME_DECODER} reads descriptor key '{key}' "
+                    f"absent from {_FRAME_FIELDS_NAME}",
+                )
+            )
+        for key in sorted(declared - reads):
+            findings.append(
+                Finding(
+                    RULE, "frame-read-drift", decl_path, decl_line,
+                    f"{_FRAME_FIELDS_NAME} declares '{key}' but "
+                    f"{_FRAME_DECODER} never reads it — dead wire "
+                    f"weight or a stale declaration",
+                )
+            )
+    return findings
+
+
 # -- the rule ----------------------------------------------------------------
 
 
@@ -559,6 +676,9 @@ def run(ctx: AnalysisContext) -> List[Finding]:
                 f"handler for '{method}' reads request key '{key}' absent "
                 f"from its wire dataclass",
             )
+
+    # codec v2 frame-descriptor contract (see module docstring)
+    findings.extend(_frame_descriptor_findings(ctx))
 
     # WIRE_SCHEMAS <-> handlers: exact match both ways
     if schemas and handlers:

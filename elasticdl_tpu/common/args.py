@@ -72,6 +72,14 @@ def add_model_spec_args(parser: argparse.ArgumentParser):
         "--transport_dtype", default="float32", choices=("float32", "bfloat16"),
         help="wire dtype for gradients/deltas",
     )
+    parser.add_argument(
+        "--sync_dtype", default="",
+        choices=("", "float32", "bfloat16", "bf16"),
+        help="sync-plane wire dtype: bf16 sends window deltas / "
+        "per-step grads as bfloat16 with an error-feedback residual "
+        "held on the worker (converges to the f32 trajectory; "
+        "default float32 = bit-exact). EDL_SYNC_DTYPE overrides.",
+    )
     parser.add_argument("--log_level", default="INFO")
     parser.add_argument(
         "--profile_dir", default="",
@@ -461,6 +469,8 @@ def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:
         "--step_pipeline", str(resolve_step_pipeline(args)),
         "--log_level", args.log_level,
     ]
+    if getattr(args, "sync_dtype", ""):
+        argv += ["--sync_dtype", args.sync_dtype]
     for flag in (
         "model_params",
         "dataset_fn",

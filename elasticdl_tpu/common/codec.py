@@ -13,11 +13,43 @@ proto message at elasticdl/proto/elasticdl.proto:43-55):
   encoded with msgpack; this replaces the reference's flat
   `map<string, Tensor>` Model message (elasticdl.proto:57-60) because
   JAX parameters are naturally nested pytrees.
+
+Wire format (codec v2, the default `dumps`): a framed layout that is
+also zero-copy on ENCODE. The old v1 encoder ran every array through
+`ndarray.tobytes()` (one full copy per array) and then msgpack copied
+the resulting bin into its output buffer (a second full copy). v2
+instead packs a small msgpack header holding dtype/shape/offset
+descriptors and appends the raw array bytes out-of-band as buffer
+views of the contiguous source arrays; the only full-size copy left is
+the final `b"".join` that materializes the single wire buffer gRPC
+needs (see docs/architecture.md, "Wire plane").
+
+    offset  size  field
+    0       1     0xC1 frame magic (a reserved, never-emitted msgpack
+                  type byte — a v1 payload can never start with it, so
+                  `loads` auto-detects both formats)
+    1       1     codec version (0x02)
+    2       4     u32 LE header length H
+    6       2     u16 LE header pad P (zeros aligning the payload)
+    8       H     msgpack header: the pytree with every array replaced
+                  by a descriptor {"d": dtype, "s": shape, "o": payload
+                  offset, "n": byte length}
+    8+H     P     zero padding so the payload starts 64-byte aligned
+    8+H+P   ...   payload: raw array bytes, each segment 64-byte
+                  aligned relative to (and including) the frame start
+
+Decode builds `np.frombuffer` views into the one received frame — the
+arrays share the frame's lifetime, exactly as v1 arrays shared their
+msgpack bin's. v1 payloads (and v1-era checkpoints) still decode:
+`loads` dispatches on the magic byte. `dumps_v1` keeps the old encoder
+reachable for cross-version tests and emergency interop.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import struct
+import threading
 from typing import Any
 
 import msgpack
@@ -34,6 +66,53 @@ except ImportError:  # pragma: no cover
 _ND_KEY = "__nd__"
 _IR_KEY = "__ir__"
 _TUPLE_KEY = "__tp__"
+
+#: v2 frame constants. 0xC1 is the one byte the msgpack spec reserves
+#: and never emits, so it unambiguously marks a framed payload.
+FRAME_MAGIC = 0xC1
+CODEC_VERSION = 2
+#: fixed prefix: magic, version, u32 header length, u16 header pad
+_FRAME_PREFIX = struct.Struct("<BBIH")
+#: payload segments start at multiples of this (relative to the frame
+#: start — the header is padded so the payload base is aligned too)
+_SEGMENT_ALIGN = 64
+
+#: The full key set a v2 array descriptor may carry. The edl-lint
+#: rpc-conformance rule cross-checks the encoder's emitted dict keys
+#: and the decoder's reads against this declaration (frame-descriptor
+#: checks in analysis/rpc_conformance.py) the same way WIRE_SCHEMAS
+#: pins request dicts: d = dtype string, s = shape list, o = byte
+#: offset into the payload, n = segment byte length (validation only —
+#: count is derived from s and d).
+FRAME_DESCRIPTOR_FIELDS = ("d", "s", "o", "n")
+
+
+class _EncodeCopyCounter(threading.local):
+    """Per-thread tally of host bytes COPIED while encoding (the
+    contiguity fallback). The zero-copy guarantee is tested against
+    this: encoding a pytree of contiguous host arrays must report 0
+    (the final frame join is the single allowed full-size copy and is
+    inherent to producing one wire buffer). Device->host transfers for
+    jax arrays are not counted — they are transfers, not wire-plane
+    copies."""
+
+    def __init__(self):
+        self.bytes = 0
+        self.arrays = 0
+
+
+_encode_copies = _EncodeCopyCounter()
+
+
+def reset_encode_copy_stats() -> None:
+    _encode_copies.bytes = 0
+    _encode_copies.arrays = 0
+
+
+def encode_copy_stats() -> dict:
+    """{"bytes": copied_bytes, "arrays": arrays_copied} since the last
+    reset on this thread."""
+    return {"bytes": _encode_copies.bytes, "arrays": _encode_copies.arrays}
 
 
 @dataclasses.dataclass
@@ -53,14 +132,13 @@ class IndexedRows:
         self.indices = np.asarray(self.indices, dtype=np.int64)
 
 
-def merge_indexed_rows(
+def _merge_indexed_rows_scatter(
     slices: list[IndexedRows], dedup: bool = False
 ) -> IndexedRows:
-    """Concatenate several IndexedRows (reference:
-    elasticdl/python/common/tensor_helper.py:4-8). With dedup=True,
-    duplicate-id rows are summed (same math the PS sparse-apply runs
-    first thing) — senders use it to shrink multi-step accumulations
-    before they hit the wire."""
+    """Reference implementation of `merge_indexed_rows` using the
+    `np.add.at` scatter. Kept (unused in production) as the oracle for
+    the property test of the reduceat fast path — scatter is an
+    order-of-magnitude slower but its semantics are the spec."""
     out = IndexedRows(
         values=np.concatenate([s.values for s in slices], axis=0),
         indices=np.concatenate([s.indices for s in slices], axis=0),
@@ -70,6 +148,41 @@ def merge_indexed_rows(
     uniq, inverse = np.unique(out.indices, return_inverse=True)
     summed = np.zeros((len(uniq),) + out.values.shape[1:], dtype=np.float32)
     np.add.at(summed, inverse, np.asarray(out.values, dtype=np.float32))
+    return IndexedRows(values=summed, indices=uniq)
+
+
+def merge_indexed_rows(
+    slices: list[IndexedRows], dedup: bool = False
+) -> IndexedRows:
+    """Concatenate several IndexedRows (reference:
+    elasticdl/python/common/tensor_helper.py:4-8). With dedup=True,
+    duplicate-id rows are summed (same math the PS sparse-apply runs
+    first thing) — senders use it to shrink multi-step accumulations
+    before they hit the wire.
+
+    The dedup sum is a stable-sort + `np.add.reduceat` group reduction
+    rather than an `np.add.at` scatter: reduceat is vectorized where
+    add.at is an element-at-a-time ufunc inner loop. The stable sort
+    preserves each id's within-group operand order, so results match
+    the scatter path up to reduceat's pairwise-summation rounding
+    (exact for integer-valued floats; see tests/test_codec.py
+    property test against `_merge_indexed_rows_scatter`)."""
+    out = IndexedRows(
+        values=np.concatenate([s.values for s in slices], axis=0),
+        indices=np.concatenate([s.indices for s in slices], axis=0),
+    )
+    if not dedup:
+        return out
+    uniq, inverse = np.unique(out.indices, return_inverse=True)
+    vals = np.asarray(out.values, dtype=np.float32)
+    if len(uniq) == 0:
+        return IndexedRows(
+            values=np.zeros((0,) + vals.shape[1:], dtype=np.float32),
+            indices=uniq,
+        )
+    order = np.argsort(inverse, kind="stable")
+    starts = np.searchsorted(inverse[order], np.arange(len(uniq)))
+    summed = np.add.reduceat(vals[order], starts, axis=0)
     return IndexedRows(values=summed, indices=uniq)
 
 
@@ -85,6 +198,23 @@ def dtype_from_str(s: str) -> np.dtype:
             raise ValueError("bfloat16 requested but ml_dtypes unavailable")
         return _BFLOAT16
     return np.dtype(s)
+
+
+def as_f32(a: Any) -> np.ndarray:
+    """Float32 VIEW of `a` when it already is f32 (the decoded wire
+    view passes through untouched, read-only and all); a widening cast
+    only when the dtype differs (bf16 wire payloads land here).
+    `np.asarray(x, dtype=np.float32)` is a no-op for f32 inputs too,
+    but spelling the intent out keeps the no-copy contract visible and
+    lintable at the PS apply sites (ps_shard.push_grad/push_delta)."""
+    a = np.asarray(a)
+    if a.dtype == np.float32:
+        return a
+    return a.astype(np.float32)
+
+
+# --------------------------------------------------------------------------
+# v1 payload form: arrays embedded as msgpack bins ({"d","s","b"})
 
 
 def _encode_array(a: np.ndarray) -> dict:
@@ -133,6 +263,122 @@ def _object_hook(m: dict) -> Any:
     return m
 
 
+# --------------------------------------------------------------------------
+# v2 frame: descriptor header + out-of-band aligned raw segments
+
+
+class _FrameBuilder:
+    """Collects payload segments during the encode walk and assigns
+    64-byte-aligned offsets. Segments are buffer VIEWS of the source
+    arrays — nothing is copied until the final frame join."""
+
+    __slots__ = ("segments", "offset")
+
+    def __init__(self):
+        # [(pad_before, uint8-view)] in payload order
+        self.segments: list = []
+        self.offset = 0
+
+    def add(self, seg: np.ndarray) -> int:
+        pad = (-self.offset) % _SEGMENT_ALIGN
+        off = self.offset + pad
+        self.segments.append((pad, seg))
+        self.offset = off + seg.nbytes
+        return off
+
+
+def _frame_descriptor(a: np.ndarray, builder: _FrameBuilder) -> dict:
+    """Append `a`'s bytes to the frame payload and return its header
+    descriptor. Zero-copy for contiguous arrays: `reshape(-1)` and
+    `view(np.uint8)` are views. Only a non-contiguous array pays a
+    compaction copy, which the encode copy counter records."""
+    a = np.asarray(a)
+    shape = list(a.shape)
+    if not a.flags["C_CONTIGUOUS"]:
+        _encode_copies.bytes += int(a.nbytes)
+        _encode_copies.arrays += 1
+        a = np.ascontiguousarray(a)
+    seg = a.reshape(-1).view(np.uint8)
+    off = builder.add(seg)
+    return {"d": _dtype_to_str(a.dtype), "s": shape, "o": off, "n": seg.nbytes}
+
+
+def _build_frame_tree(obj: Any, builder: _FrameBuilder) -> Any:
+    """Replace every array in the pytree with a frame descriptor,
+    collecting the raw segments in `builder`. Container structure and
+    scalar leaves pass through for the msgpack header."""
+    if isinstance(obj, IndexedRows):
+        return {
+            _IR_KEY: True,
+            "v": {_ND_KEY: True, **_frame_descriptor(obj.values, builder)},
+            "i": {_ND_KEY: True, **_frame_descriptor(obj.indices, builder)},
+        }
+    if isinstance(obj, np.ndarray):
+        return {_ND_KEY: True, **_frame_descriptor(obj, builder)}
+    if isinstance(obj, dict):
+        return {k: _build_frame_tree(v, builder) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_build_frame_tree(v, builder) for v in obj]
+    if isinstance(obj, tuple):
+        # stays a tuple so packb's strict_types routes it to _default's
+        # {_TUPLE_KEY: ...} wrapper — round-trips as a tuple
+        return tuple(_build_frame_tree(v, builder) for v in obj)
+    if isinstance(obj, (str, bytes, bytearray, bool, int, float)) or obj is None:
+        return obj
+    if isinstance(obj, (np.floating, np.integer, np.bool_)):
+        return obj.item()
+    # jax.Array and DeviceArray duck-type via __array__ (device->host
+    # transfer — deliberately not counted as an encode copy)
+    if hasattr(obj, "__array__"):
+        return {_ND_KEY: True, **_frame_descriptor(np.asarray(obj), builder)}
+    return obj  # let packb/_default accept or reject it
+
+
+def _read_frame_descriptor(m: dict, frame, payload_start: int) -> np.ndarray:
+    """Materialize one descriptor as an `np.frombuffer` view into the
+    frame (read-only, shares the frame's lifetime — v1 semantics, one
+    buffer instead of one per array)."""
+    dt = dtype_from_str(m["d"])
+    shape = m["s"]
+    count = 1
+    for dim in shape:
+        count *= int(dim)
+    nbytes = count * dt.itemsize
+    if m["n"] != nbytes:
+        raise ValueError(
+            f"corrupt frame descriptor: {m['n']} bytes for "
+            f"dtype {m['d']} shape {shape} (expected {nbytes})"
+        )
+    arr = np.frombuffer(
+        frame, dtype=dt, count=count, offset=payload_start + m["o"]
+    )
+    return arr.reshape(shape)
+
+
+def _loads_frame(data) -> Any:
+    magic, version, hlen, pad = _FRAME_PREFIX.unpack_from(data, 0)
+    if version != CODEC_VERSION:
+        raise ValueError(f"unsupported codec frame version {version}")
+    header_end = _FRAME_PREFIX.size + hlen
+    payload_start = header_end + pad
+
+    def hook(m: dict) -> Any:
+        if _ND_KEY in m:
+            return _read_frame_descriptor(m, data, payload_start)
+        if _IR_KEY in m:
+            # descriptors carry _ND_KEY, so msgpack's bottom-up hooks
+            # already turned v/i into arrays
+            return IndexedRows(values=m["v"], indices=m["i"])
+        if _TUPLE_KEY in m:
+            return tuple(m[_TUPLE_KEY])
+        return m
+
+    header = bytes(data[_FRAME_PREFIX.size:header_end])
+    return msgpack.unpackb(
+        header, object_hook=hook, raw=False, strict_map_key=False
+    )
+
+
 def all_float_leaves(tree) -> bool:
     import jax
 
@@ -156,28 +402,88 @@ def ravel_np(tree) -> np.ndarray:
     )
 
 
-def unravel_np(vec: np.ndarray, template) -> Any:
-    """Inverse of ravel_np given a template tree with the same
-    structure/shapes (e.g. the PS's param tree)."""
+def template_meta(template) -> tuple:
+    """(shapes, sizes, treedef) of a pytree — the unravel plan. One
+    `np.asarray` per leaf; callers on hot paths cache the result via
+    `make_unraveler` instead of re-deriving it per pull."""
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten(template)
-    vec = np.asarray(vec, dtype=np.float32)
-    out, off = [], 0
+    shapes, sizes = [], []
     for leaf in leaves:
-        n = int(np.prod(np.asarray(leaf).shape, dtype=np.int64)) if np.asarray(leaf).ndim else 1
-        out.append(vec[off : off + n].reshape(np.asarray(leaf).shape))
-        off += n
-    if off != vec.size:
-        raise ValueError(f"flat vector size {vec.size} != template size {off}")
-    return jax.tree_util.tree_unflatten(treedef, out)
+        a = np.asarray(leaf)
+        shapes.append(a.shape)
+        sizes.append(int(a.size))
+    return shapes, sizes, treedef
+
+
+def make_unraveler(template):
+    """Build a reusable `vec -> pytree` closure from `template`.
+
+    Model-pull hot path: the template's structure is fixed for the life
+    of a job, so the (shapes, sizes, treedef) plan is computed once and
+    every call is just len(leaves) slice+reshape views."""
+    import jax
+
+    shapes, sizes, treedef = template_meta(template)
+    total = sum(sizes)
+
+    def unravel(vec) -> Any:
+        vec = np.asarray(vec, dtype=np.float32)
+        if vec.size != total:
+            raise ValueError(
+                f"flat vector size {vec.size} != template size {total}"
+            )
+        out, off = [], 0
+        for shape, n in zip(shapes, sizes):
+            out.append(vec[off : off + n].reshape(shape))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return unravel
+
+
+def unravel_np(vec: np.ndarray, template) -> Any:
+    """Inverse of ravel_np given a template tree with the same
+    structure/shapes (e.g. the PS's param tree). One-shot form of
+    `make_unraveler(template)(vec)`."""
+    return make_unraveler(template)(vec)
 
 
 def dumps(obj: Any) -> bytes:
-    """Serialize a pytree (nested dict/list/tuple of arrays, scalars, strings)."""
+    """Serialize a pytree (nested dict/list/tuple of arrays, scalars,
+    strings) as a v2 frame. Contiguous array bytes enter the frame as
+    buffer views; the single full-size copy is the final join."""
+    builder = _FrameBuilder()
+    tree = _build_frame_tree(obj, builder)
+    header = msgpack.packb(
+        tree, default=_default, use_bin_type=True, strict_types=True
+    )
+    head_pad = (-(_FRAME_PREFIX.size + len(header))) % _SEGMENT_ALIGN
+    parts = [
+        _FRAME_PREFIX.pack(FRAME_MAGIC, CODEC_VERSION, len(header), head_pad),
+        header,
+    ]
+    if head_pad:
+        parts.append(b"\x00" * head_pad)
+    for pad, seg in builder.segments:
+        if pad:
+            parts.append(b"\x00" * pad)
+        parts.append(seg)
+    return b"".join(parts)
+
+
+def dumps_v1(obj: Any) -> bytes:
+    """The pre-frame encoder (arrays embedded as msgpack bins, one
+    `tobytes()` copy per array). Kept for cross-version decode tests
+    and as an escape hatch while mixed-version jobs drain."""
     return msgpack.packb(obj, default=_default, use_bin_type=True, strict_types=True)
 
 
 def loads(data: bytes) -> Any:
-    """Deserialize; array buffers are zero-copy views over `data`."""
+    """Deserialize either codec version; array buffers are zero-copy
+    views over `data`. v2 frames are detected by the 0xC1 magic byte
+    (reserved in msgpack — no v1 payload starts with it)."""
+    if len(data) >= _FRAME_PREFIX.size and data[0] == FRAME_MAGIC:
+        return _loads_frame(data)
     return msgpack.unpackb(data, object_hook=_object_hook, raw=False, strict_map_key=False)

@@ -67,6 +67,7 @@ ENV_RPC_RETRIES = "EDL_RPC_RETRIES"
 ENV_RPC_BACKOFF = "EDL_RPC_BACKOFF"
 ENV_RPC_SEED = "EDL_RPC_SEED"
 ENV_SYNC_DEPTH = "EDL_SYNC_DEPTH"
+ENV_SYNC_DTYPE = "EDL_SYNC_DTYPE"
 ENV_OPT_MIRROR_SECS = "EDL_OPT_MIRROR_SECS"
 ENV_BET_PREFETCH = "EDL_BET_PREFETCH"
 ENV_BENCH_MFU = "EDL_BENCH_MFU"
@@ -98,6 +99,11 @@ ENV_REGISTRY = {
     ENV_SYNC_DEPTH: (
         "max in-flight pipelined window syncs per worker (0 serializes; "
         "default 2)"
+    ),
+    ENV_SYNC_DTYPE: (
+        "sync-plane wire dtype: bf16 sends window deltas / per-step "
+        "grads as bfloat16 with error-feedback residuals held on the "
+        "worker (default float32 = bit-exact)"
     ),
     ENV_OPT_MIRROR_SECS: (
         "recovery plane: seconds between PS optimizer-state mirror "

@@ -194,6 +194,7 @@ class PSShardGroup:
             **self._sync_flags,
         )
         server = RpcServer(servicer.handlers(), port=0)
+        servicer.attach_wire_stats(server.wire)
         server.start()
         return servicer, server
 

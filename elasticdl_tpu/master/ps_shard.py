@@ -124,6 +124,10 @@ class PSShardServicer:
         # here, not double-apply)
         self._duplicate_pushes = 0
         self._applied_pushes = 0
+        # wire-byte accounting: the hosting RpcServer's WireStats,
+        # attached by shard_host/ps_group after server construction so
+        # `stats()` answers bytes questions over the existing stats RPC
+        self._wire = None
 
     # -- handler table -------------------------------------------------------
 
@@ -210,7 +214,12 @@ class PSShardServicer:
         equality rejection is refused at configuration time (module
         docstring) so an accept can never be torn across shards."""
         self._check_epoch(req)
-        grad = np.asarray(req["grad"], dtype=np.float32)
+        # no-copy when the wire already carried f32: the decoded
+        # frombuffer view is applied as-is (it is read-only, and every
+        # consumer below uses it only as a ufunc operand); a bf16 wire
+        # payload (EDL_SYNC_DTYPE=bf16) widens to f32 here — shard math
+        # is always full precision
+        grad = codec.as_f32(req["grad"])
         report_version = int(req.get("version", -1))
         with self._lock:
             if self._vec is None:
@@ -272,7 +281,7 @@ class PSShardServicer:
                     "vec": self._wire_vec(req),
                     "duplicate": True,
                 }
-            delta = np.asarray(req["delta"], dtype=np.float32)
+            delta = codec.as_f32(req["delta"])
             if delta.shape != self._vec.shape:
                 raise ValueError(
                     f"delta slice shape {delta.shape} != {self._vec.shape}"
@@ -292,18 +301,31 @@ class PSShardServicer:
 
     # -- internals -----------------------------------------------------------
 
+    def attach_wire_stats(self, wire):
+        """Point stats() at the hosting RpcServer's WireStats (called
+        once right after server construction, before start)."""
+        self._wire = wire
+
     def stats(self) -> Dict[str, int]:
         """Push accounting (exactness evidence for the chaos tests):
         `applied_pushes` counts pushes that mutated state,
         `duplicate_pushes` counts retried resends the dedup ring
-        absorbed. applied + duplicate == pushes received."""
+        absorbed. applied + duplicate == pushes received. When the
+        hosting server attached its WireStats, also wire bytes in/out
+        of this shard (bytes_received ~ push payload cost, bytes_sent ~
+        model-down cost)."""
         with self._lock:
-            return {
+            out = {
                 "applied_pushes": self._applied_pushes,
                 "duplicate_pushes": self._duplicate_pushes,
                 "version": self._version,
                 "generation": self.generation,
             }
+        if self._wire is not None:
+            snap = self._wire.snapshot()
+            out["bytes_sent"] = snap["bytes_sent"]
+            out["bytes_received"] = snap["bytes_received"]
+        return out
 
     def _is_duplicate(self, req: dict) -> bool:  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """True if req's report_key was already APPLIED (caller holds

@@ -107,6 +107,7 @@ def main(argv=None) -> int:
         dedup_cap=args.dedup_cap or None,
     )
     server = RpcServer(servicer.handlers(), port=args.port)
+    servicer.attach_wire_stats(server.wire)
     server.start()
     logger.info(
         "PS shard %d/%d (generation %d) listening on :%d",

@@ -134,6 +134,11 @@ class MasterServicer:
         # acked apply is covered by some worker snapshot at >= it)
         self._shard_version_max: Optional[list] = None
         self._recovery_plane = None
+        # model-pull hot path: the unravel plan (shapes/sizes/treedef
+        # of self._params) is derived once and reused — see
+        # codec.make_unraveler. Rebuilt lazily if the template ever
+        # changes size (checkpoint restore of a different model).
+        self._unraveler = None
 
     # -- handler table (the 6 reference RPCs + embedding plane) -------------
 
@@ -198,9 +203,8 @@ class MasterServicer:
             if vec is not None:
                 with self._lock:
                     aux = jax.tree_util.tree_map(np.copy, self._aux)
-                    template = self._params
                 return (
-                    codec.unravel_np(vec, template),
+                    self._unravel_model(vec),
                     aux,
                     min(versions),
                 )
@@ -285,7 +289,7 @@ class MasterServicer:
                 return {"version": v, "params_flat": vec, "aux": aux}
             return {
                 "version": v,
-                "params": codec.unravel_np(vec, template),
+                "params": self._unravel_model(vec),
                 "aux": aux,
             }
         if method == MethodType.MINIMUM:
@@ -375,7 +379,7 @@ class MasterServicer:
             if self._params is None:
                 raise ValueError("gradient reported before model init")
             if grads is None and req.get("gradient_flat") is not None:
-                grads = codec.unravel_np(req["gradient_flat"], self._params)
+                grads = self._unravel_model(req["gradient_flat"])
             staleness = self._version - report_version
             if not self._use_async and staleness > self._staleness_window:
                 # stale: reject AND piggyback the fresh model so the
@@ -508,7 +512,7 @@ class MasterServicer:
                 staleness = self._version - base_version
                 if staleness > self._staleness_window:
                     scale = self._staleness_window / float(staleness)
-            delta = codec.unravel_np(req["delta_flat"], self._params)
+            delta = self._unravel_model(req["delta_flat"])
             self._params = jax.tree_util.tree_map(
                 lambda p, d: p + scale * np.asarray(d, dtype=np.float32),
                 self._params,
@@ -699,6 +703,19 @@ class MasterServicer:
         # sharded mode relative to single-PS, which records every apply
         self._report_train_loss(max(version, prev), req.get("loss"))
         return resp
+
+    def _unravel_model(self, vec):  # edl-lint: disable=lock-discipline -- template read only: the param STRUCTURE is fixed for the life of a job (values are irrelevant to the unravel plan), and report callers already hold the non-reentrant self._lock
+        """vec -> pytree against the current param template, through
+        the cached unravel plan (structure is fixed for the life of a
+        job; a size mismatch — different model restored — rebuilds)."""
+        u = self._unraveler
+        if u is None:
+            u = self._unraveler = codec.make_unraveler(self._params)
+        try:
+            return u(vec)
+        except ValueError:
+            u = self._unraveler = codec.make_unraveler(self._params)
+            return u(vec)
 
     def _flat_model(self, model_dtype=None):  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """Raveled params, optionally narrowed to the worker's wire

@@ -25,6 +25,7 @@ from elasticdl_tpu.rpc.policy import (
     IDEMPOTENT_METHODS,
     CircuitBreaker,
     RetryPolicy,
+    wire_stats_for,
 )
 
 
@@ -51,6 +52,10 @@ class RpcClient:
         # worker threads race on the first call of each method; the
         # memoization dict insert must be atomic
         self._calls_lock = threading.Lock()
+        # per-endpoint wire-byte accounting, shared across reconnects
+        # (rpc/policy.wire_stats_for); counted around the policy call
+        # so retries of one logical call still tally each resend
+        self.wire = wire_stats_for(addr)
 
     def wait_ready(self, timeout: float = 30.0):
         grpc.channel_ready_future(self._channel).result(timeout=timeout)
@@ -74,8 +79,15 @@ class RpcClient:
         if idempotent is None:
             idempotent = method in IDEMPOTENT_METHODS
         payload = messages.pack(request if request is not None else {})
+
+        def attempt(remaining):
+            self.wire.record(method, sent=len(payload))
+            resp_bytes = stub(payload, timeout=remaining)
+            self.wire.record(method, received=len(resp_bytes))
+            return resp_bytes
+
         resp = self._policy.call(
-            lambda remaining: stub(payload, timeout=remaining),
+            attempt,
             method=method,
             timeout=timeout,
             idempotent=idempotent,

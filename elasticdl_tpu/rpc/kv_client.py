@@ -208,6 +208,15 @@ class ShardedEmbeddingStore:
             ]
         )
 
+    def wire_stats(self) -> dict:
+        """Summed wire-byte totals across the KV shard fan-out (same
+        shape as ShardedPS.wire_stats — see rpc/policy.WireStats)."""
+        from elasticdl_tpu.rpc.policy import aggregate_wire_snapshots
+
+        return aggregate_wire_snapshots(
+            c.wire.snapshot() for c in self._clients
+        )
+
     def close(self):
         # drain in-flight lookups/updates first (shard RPCs are short):
         # closing the channels under a still-submitting window sync

@@ -107,6 +107,105 @@ DEDUP_KEYED_METHODS: FrozenSet[str] = frozenset(
 )
 
 
+class WireStats:
+    """Per-endpoint wire-byte accounting: bytes_sent / bytes_received /
+    calls, broken down by method. One instance is shared by every
+    `RpcClient` dialing the same endpoint (see `wire_stats_for`) and
+    one per `RpcServer`, so "how many bytes does a sync cost" is
+    answerable from either side of the link without packet captures —
+    the policy layer is the one place every RPC already flows through,
+    so the counters live next to the retry/breaker state.
+
+    Counters are payload bytes as handed to / received from gRPC
+    (post-codec, pre-HTTP/2 framing): exactly the bytes the codec
+    controls, which is what the bf16-vs-f32 and v1-vs-v2 comparisons
+    need. Thread-safe; snapshot() returns plain dicts for stats()/bench
+    JSON surfaces."""
+
+    def __init__(self, endpoint: str = ""):
+        self.endpoint = endpoint
+        self._lock = threading.Lock()
+        # method -> [bytes_sent, bytes_received, calls]
+        self._methods: dict = {}
+
+    def record(self, method: str, sent: int = 0, received: int = 0):
+        with self._lock:
+            row = self._methods.get(method)
+            if row is None:
+                row = self._methods[method] = [0, 0, 0]
+            row[0] += int(sent)
+            row[1] += int(received)
+            row[2] += 1 if sent else 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            methods = {
+                m: {"bytes_sent": r[0], "bytes_received": r[1], "calls": r[2]}
+                for m, r in self._methods.items()
+            }
+        return {
+            "endpoint": self.endpoint,
+            "bytes_sent": sum(v["bytes_sent"] for v in methods.values()),
+            "bytes_received": sum(
+                v["bytes_received"] for v in methods.values()
+            ),
+            "calls": sum(v["calls"] for v in methods.values()),
+            "methods": methods,
+        }
+
+    def reset(self):
+        with self._lock:
+            self._methods.clear()
+
+
+_wire_registry_lock = threading.Lock()
+_wire_registry: dict = {}
+
+
+def wire_stats_for(endpoint: str) -> WireStats:
+    """The process-wide WireStats for `endpoint` (created on first
+    use). Sharing per endpoint means a reconnect (new RpcClient, e.g.
+    after a shard failover) keeps accumulating into the same row."""
+    with _wire_registry_lock:
+        ws = _wire_registry.get(endpoint)
+        if ws is None:
+            ws = _wire_registry[endpoint] = WireStats(endpoint)
+        return ws
+
+
+def all_wire_stats() -> dict:
+    """{endpoint: snapshot} for every endpoint this process dialed."""
+    with _wire_registry_lock:
+        entries = list(_wire_registry.items())
+    return {ep: ws.snapshot() for ep, ws in entries}
+
+
+def aggregate_wire_snapshots(snapshots) -> dict:
+    """Sum WireStats snapshots (e.g. a shard fan-out's N clients) into
+    one {bytes_sent, bytes_received, methods} rollup: one logical push
+    is num_shards slice sends, and "bytes per sync" means their SUM."""
+    methods: dict = {}
+    for snap in snapshots:
+        for m, row in snap["methods"].items():
+            agg = methods.setdefault(
+                m, {"bytes_sent": 0, "bytes_received": 0, "calls": 0}
+            )
+            for k in agg:
+                agg[k] += row[k]
+    return {
+        "bytes_sent": sum(v["bytes_sent"] for v in methods.values()),
+        "bytes_received": sum(v["bytes_received"] for v in methods.values()),
+        "methods": methods,
+    }
+
+
+def reset_wire_stats():
+    with _wire_registry_lock:
+        entries = list(_wire_registry.values())
+    for ws in entries:
+        ws.reset()
+
+
 class PolicyRpcError(grpc.RpcError):
     """grpc.RpcError with an explicit status code, raisable client-side."""
 

@@ -308,6 +308,16 @@ class ShardedPS:
             out[s:e] = sl
         return out
 
+    def wire_stats(self) -> dict:
+        """Aggregate wire-byte accounting across the shard fan-out
+        (one logical push = num_shards slice sends; bytes-per-sync
+        means their SUM — see rpc/policy.WireStats)."""
+        from elasticdl_tpu.rpc.policy import aggregate_wire_snapshots
+
+        return aggregate_wire_snapshots(
+            c.wire.snapshot() for c in self._clients
+        )
+
     def close(self):
         self._pool.shutdown(wait=False)
         for c in self._clients:

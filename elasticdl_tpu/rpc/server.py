@@ -20,10 +20,11 @@ from elasticdl_tpu.common.log_util import get_logger
 logger = get_logger(__name__)
 
 
-def _wrap(fn: Callable) -> Callable:
+def _wrap(fn: Callable, method: str, wire) -> Callable:
     def handler(request_bytes: bytes, context) -> bytes:
         from elasticdl_tpu.rpc.fencing import EpochFencedError
 
+        wire.record(method, received=len(request_bytes) if request_bytes else 0)
         req = messages.unpack(request_bytes) if request_bytes else None
         try:
             resp = fn(req) if req is not None else fn({})
@@ -41,7 +42,9 @@ def _wrap(fn: Callable) -> Callable:
             # from an uninitialized shard without reading server logs.
             detail = f"{type(e).__name__}: {e}".replace("\n", " ")[:256]
             context.abort(grpc.StatusCode.INTERNAL, detail)
-        return messages.pack(resp)
+        resp_bytes = messages.pack(resp)
+        wire.record(method, sent=len(resp_bytes))
+        return resp_bytes
 
     return handler
 
@@ -61,9 +64,16 @@ class RpcServer:
         max_workers: int = 64,
         fault_plan=None,
     ):
+        # server-side wire-byte accounting (payload bytes per method);
+        # surfaced via `wire_stats()` and shard `stats()` RPCs
+        from elasticdl_tpu.rpc.policy import WireStats
+
+        self.wire = WireStats("server")
         method_handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                _wrap(fn), request_deserializer=None, response_serializer=None
+                _wrap(fn, name, self.wire),
+                request_deserializer=None,
+                response_serializer=None,
             )
             for name, fn in handlers.items()
         }
@@ -84,6 +94,11 @@ class RpcServer:
 
     def start(self):
         self._server.start()
+
+    def wire_stats(self) -> dict:
+        """Per-method bytes_sent/bytes_received snapshot (see
+        rpc/policy.WireStats)."""
+        return self.wire.snapshot()
 
     def stop(self, grace: float = 0.5):
         self._server.stop(grace)

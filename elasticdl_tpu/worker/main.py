@@ -108,6 +108,7 @@ def main(argv=None) -> int:
         ps_endpoints=ps_endpoints,
         step_pipeline=args.step_pipeline,
         kv_endpoints=kv_endpoints,
+        sync_dtype=args.sync_dtype or None,
     )
     # device-level tracing (SURVEY §5.1): a jax.profiler trace of the
     # whole task loop, viewable in TensorBoard/Perfetto/XProf. The

@@ -43,6 +43,7 @@ from elasticdl_tpu.common.constants import (
     ENV_BENCH_MFU,
     ENV_BET_PREFETCH,
     ENV_SYNC_DEPTH,
+    ENV_SYNC_DTYPE,
     MAX_MINIBATCH_RETRY_NUM,
     Mode,
 )
@@ -105,6 +106,7 @@ class Worker:
         ps_endpoints=None,  # sharded PS (master/ps_shard.py) fan-out
         step_pipeline: int = 0,
         kv_endpoints=None,  # sharded embedding KV (master/kv_group.py)
+        sync_dtype: Optional[str] = None,  # bf16 sync plane w/ EF residual
     ):
         self._id = worker_id
         self._master = master
@@ -120,6 +122,44 @@ class Worker:
         self._minibatch_size = minibatch_size
         self._mesh = mesh
         self._transport_dtype = transport_dtype
+        # Opt-in lossy sync plane (--sync_dtype bf16 / EDL_SYNC_DTYPE):
+        # window deltas and per-step flat grads ride the wire as
+        # bfloat16, with the quantization error kept locally as an
+        # error-feedback residual that is folded into the NEXT delta
+        # before quantizing — the running sum of what the PS applied
+        # tracks the true f32 trajectory to within one bf16 quantum,
+        # so window math converges instead of accumulating drift.
+        # Default float32 keeps the sync plane bit-exact.
+        if sync_dtype is None:
+            sync_dtype = os.environ.get(ENV_SYNC_DTYPE, "") or "float32"
+        sync_dtype = {"bf16": "bfloat16", "f32": "float32"}.get(
+            sync_dtype, sync_dtype
+        )
+        if sync_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unsupported sync_dtype {sync_dtype!r} "
+                "(float32|bfloat16|bf16)"
+            )
+        if sync_dtype == "bfloat16" and _BF16 is None:  # pragma: no cover
+            logger.warning(
+                "sync_dtype=bfloat16 requested but ml_dtypes is "
+                "unavailable; falling back to float32"
+            )
+            sync_dtype = "float32"
+        self._sync_dtype = sync_dtype
+        if sync_dtype == "bfloat16" and transport_dtype == "bfloat16":
+            # EF quantization needs the FULL-precision delta/grad as its
+            # input (residual = f32 - bf16(f32)); the legacy step-fn
+            # pre-cast would destroy the residual source, so sync_dtype
+            # supersedes it. Model-down still rides bf16 (see
+            # _model_wire_dtype), so no wire bytes are lost.
+            logger.info(
+                "sync_dtype=bfloat16 supersedes transport_dtype=bfloat16"
+            )
+            self._transport_dtype = "float32"
+        self._ef_residual = None  # device f32 [n], window-delta EF
+        self._ef_grad_residual = None  # device f32 [n], per-step EF
+        self._ef_lock = threading.Lock()  # pipelined reports quantize
         # rng lives on CPU: eager host-side ops (init, embedding row
         # draws) must not become per-op round-trips to a remote device
         with jax.default_device(jax.local_devices(backend="cpu")[0]):
@@ -317,11 +357,7 @@ class Worker:
                 known_versions = self._shard_versions
             versions, vec = self._ps.pull(
                 versions=known_versions,
-                model_dtype=(
-                    "bfloat16"
-                    if self._transport_dtype == "bfloat16"
-                    else None
-                ),
+                model_dtype=self._model_wire_dtype(),
             )
             if any(v < 0 for v in versions):
                 return False  # shards not initialized yet
@@ -441,6 +477,10 @@ class Worker:
         newer model between compute and send, and reporting the newer
         version for an older gradient would corrupt the PS's staleness
         accounting."""
+        if flat and self._sync_dtype == "bfloat16":
+            # quantize ON DEVICE before the d2h round: halves the
+            # device-link bytes too, and the EF residual stays resident
+            grads = self._ef_quantize_grad(grads)
         grads_h, aux_h, loss_h = jax.device_get(
             (grads, aux_state or None, loss)
         )
@@ -454,9 +494,7 @@ class Worker:
             # back the same way, and the tiny metadata (loss, aux,
             # versions) goes to the master's control plane which drives
             # the checkpoint/eval cadence + metrics sink.
-            model_dtype = (
-                "bfloat16" if self._transport_dtype == "bfloat16" else None
-            )
+            model_dtype = self._model_wire_dtype()
             if shard_base is not None:
                 base = shard_base
             else:
@@ -541,13 +579,15 @@ class Worker:
         if loss_h is not None:
             req["loss"] = float(loss_h)  # feeds the master's metrics sink
         if flat:
-            # already bf16-cast on device by the step fn when requested
+            # already bf16-cast on device: by the step fn under
+            # transport_dtype, or by the EF quantizer under sync_dtype
             req["gradient_flat"] = grads_h
             req["return_model"] = True
-            if self._transport_dtype == "bfloat16":
+            md = self._model_wire_dtype()
+            if md:
                 # ask for the piggybacked model in bf16 too: halves the
                 # response h2d bytes on the per-step critical path
-                req["model_dtype"] = "bfloat16"
+                req["model_dtype"] = md
         else:
             req["gradient"] = jax.tree_util.tree_map(self._to_wire_dtype, grads_h)
         return self._master.call("ReportGradient", req), loss_h
@@ -561,6 +601,59 @@ class Worker:
         ):
             return g.astype(_BF16)
         return g
+
+    def _model_wire_dtype(self):
+        """Dtype requested for model-DOWN payloads (pull / piggyback).
+        The down direction carries no residual (the worker immediately
+        widens to f32 and trains on), so it is plain quantization —
+        requested whenever EITHER lossy knob is on."""
+        if (
+            self._transport_dtype == "bfloat16"
+            or self._sync_dtype == "bfloat16"
+        ):
+            return "bfloat16"
+        return None
+
+    # ----------------------------------------- error-feedback quantization
+    #
+    # sync_dtype=bfloat16: what rides the wire is bf16(x + residual) and
+    # the worker keeps residual' = (x + residual) - f32(bf16(x+residual))
+    # on device. The PS accumulates the quantized stream in f32; its sum
+    # equals the true f32 sum minus the CURRENT residual, so the error
+    # is bounded by one bf16 quantum of the running total instead of
+    # growing with the step count — that is what lets window deltas
+    # converge to the f32 trajectory (tests/test_codec.py EF test).
+
+    def _ef_quantize_delta(self, delta_dev):
+        """Window-delta EF (called at sync SPAWN on the main thread —
+        spawns are sequential, so the residual handoff needs no lock).
+        The residual is folded into the next window even when windows
+        overlap in flight: each spawn consumes the residual left by the
+        previous spawn, preserving the telescoping sum."""
+        if self._ef_residual is None or (
+            self._ef_residual.shape != delta_dev.shape
+        ):
+            self._ef_residual = jnp.zeros_like(delta_dev)
+        comp = delta_dev + self._ef_residual
+        q = comp.astype(jnp.bfloat16)
+        self._ef_residual = comp - q.astype(jnp.float32)
+        return q
+
+    def _ef_quantize_grad(self, grad_dev):
+        """Per-step flat-gradient EF. Pipelined reports quantize from
+        worker threads concurrently — the residual read-modify-write
+        must be atomic or two steps would consume the same residual
+        (losing one step's error mass permanently)."""
+        with self._ef_lock:
+            if self._ef_grad_residual is None or (
+                getattr(self._ef_grad_residual, "shape", None)
+                != getattr(grad_dev, "shape", None)
+            ):
+                self._ef_grad_residual = jnp.zeros_like(grad_dev)
+            comp = grad_dev + self._ef_grad_residual
+            q = comp.astype(jnp.bfloat16)
+            self._ef_grad_residual = comp - q.astype(jnp.float32)
+        return q
 
     def report_task_result(self, task_id: int, err: str = ""):
         self._master.call(
@@ -1174,8 +1267,15 @@ class Worker:
             self._flush_deferred_reports()
             return
         delta_dev = self._flat - self._base_flat  # own buffer, thread-safe
-        if self._transport_dtype == "bfloat16" and _BF16 is not None:
-            # cast on DEVICE: halves the per-window d2h bytes
+        if self._sync_dtype == "bfloat16":
+            # EF quantization at spawn time, still on the main thread:
+            # chained syncs spawn in dispatch order, so each window
+            # consumes the residual its predecessor left — the wire
+            # carries bf16 but the SUM of what the PS applies tracks
+            # the f32 trajectory (see _ef_quantize_delta)
+            delta_dev = self._ef_quantize_delta(delta_dev)
+        elif self._transport_dtype == "bfloat16" and _BF16 is not None:
+            # plain cast on DEVICE: halves the per-window d2h bytes
             delta_dev = delta_dev.astype(jnp.bfloat16)
         steps = self._pending_steps
         aux_dev = self._aux  # device refs; materialized in the thread
@@ -1270,10 +1370,11 @@ class Worker:
                     name: merge_indexed_rows(slices, dedup=True)
                     for name, slices in per_table.items()
                 }
-            if self._transport_dtype == "bfloat16":
+            md = self._model_wire_dtype()
+            if md:
                 # merged-model piggyback in bf16: halves the response
                 # bytes on every multi-worker window sync
-                req["model_dtype"] = "bfloat16"
+                req["model_dtype"] = md
             if step_loss_h is not None:
                 req["loss"] = float(step_loss_h)  # master's metrics sink
             if self._ensure_ps() is not None:
@@ -1458,6 +1559,12 @@ class Worker:
         self._pending_steps = 0
         self._pending_losses = []
         self._pending_edl = []
+        # the residual's error mass belongs to the trajectory being
+        # discarded — carrying it into the re-pulled state would inject
+        # a phantom correction into the first post-reset window
+        self._ef_residual = None
+        with self._ef_lock:
+            self._ef_grad_residual = None
 
     # ----------------------------------------------- shard-outage recovery
 

@@ -581,8 +581,13 @@ def test_reset_local_state_clears_shard_versions():
     w._opt_state = object()
     w._pending_steps = 3
     w._pending_losses = [0.1]
+    w._ef_lock = threading.Lock()
+    w._ef_residual = object()
+    w._ef_grad_residual = object()
     w._reset_local_state()
     assert w._shard_versions is None
     assert w._version == -1
     assert not w._fresh
     assert w._sync_result is None and not w._base_snapshots
+    # error-feedback residuals belong to the discarded trajectory
+    assert w._ef_residual is None and w._ef_grad_residual is None

@@ -188,6 +188,55 @@ def test_rpc_conformance_dynamic_request_skipped(tmp_path):
     assert "unknown-request-key" not in checks
 
 
+FRAME_GOOD = """
+FRAME_DESCRIPTOR_FIELDS = ("d", "s", "o", "n")
+
+
+def _frame_descriptor(a, builder):
+    return {"d": "dt", "s": [1], "o": 0, "n": 4}
+
+
+def _read_frame_descriptor(m, frame, payload_start):
+    return (m["d"], m["s"], m["o"], m["n"])
+"""
+
+
+def test_frame_descriptor_contract_clean(tmp_path):
+    root = _tree(tmp_path, {"codec.py": FRAME_GOOD})
+    assert run_analysis(root, rules=["rpc-conformance"]) == []
+
+
+def test_frame_descriptor_emit_drift(tmp_path):
+    # encoder grows a field the declaration doesn't know about
+    src = FRAME_GOOD.replace('"n": 4}', '"n": 4, "z": 9}')
+    root = _tree(tmp_path, {"codec.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance"
+    )
+    assert "frame-emit-drift" in checks
+
+
+def test_frame_descriptor_read_drift_both_ways(tmp_path):
+    # decoder reads an undeclared key AND skips a declared one
+    src = FRAME_GOOD.replace('m["n"])', 'm["ghost"])')
+    root = _tree(tmp_path, {"codec.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance"
+    )
+    assert "frame-read-drift" in checks
+
+
+def test_frame_descriptor_lints_the_real_codec():
+    """The shipped codec must satisfy its own declared contract."""
+    import elasticdl_tpu
+
+    root = os.path.dirname(elasticdl_tpu.__file__)
+    findings = run_analysis(root, rules=["rpc-conformance"])
+    assert not [
+        f for f in findings if f.check.startswith("frame-")
+    ], findings
+
+
 # -- lock-discipline ---------------------------------------------------------
 
 LOCK_BAD = """
