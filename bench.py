@@ -62,11 +62,18 @@ def run_job(
     grads_to_wait,
     transport_dtype="float32",
     sync_dtype=None,
+    sync_compress=None,
+    transport=None,
     staleness_window=0,
     step_pipeline=0,
     spec_overrides=None,
 ):
-    """One full PS training job; returns (images_per_sec, worker, wall)."""
+    """One full PS training job; returns (images_per_sec, worker, wall).
+
+    `transport` pins EDL_TRANSPORT ("inproc"/"uds"/"auto") for the
+    server+client construction window — tier selection happens at
+    RpcServer/RpcClient build time (rpc/transport.py), so the env only
+    needs to cover those lines and is restored right after."""
     import numpy as np
 
     from elasticdl_tpu.api.model_spec_helpers import spec_from_module
@@ -98,9 +105,21 @@ def run_job(
         embedding_store=store,
         sparse_optimizer=sparse_opt,
     )
-    server = RpcServer(servicer.handlers(), port=0)
-    server.start()
-    client = RpcClient(f"localhost:{server.port}")
+    from elasticdl_tpu.common.constants import ENV_TRANSPORT
+
+    prev_transport = os.environ.get(ENV_TRANSPORT)
+    if transport is not None:
+        os.environ[ENV_TRANSPORT] = transport
+    try:
+        server = RpcServer(servicer.handlers(), port=0)
+        server.start()
+        client = RpcClient(f"localhost:{server.port}")
+    finally:
+        if transport is not None:
+            if prev_transport is None:
+                os.environ.pop(ENV_TRANSPORT, None)
+            else:
+                os.environ[ENV_TRANSPORT] = prev_transport
     client.wait_ready(10)
 
     spec = spec_from_module(model_module, **(spec_overrides or {}))
@@ -113,6 +132,7 @@ def run_job(
         transport_dtype=transport_dtype,
         step_pipeline=step_pipeline,
         sync_dtype=sync_dtype,
+        sync_compress=sync_compress,
     )
 
     # ---- untimed AOT warm-up: compile + one throwaway execution ----
@@ -154,6 +174,9 @@ def run_job(
         "bytes_per_sync_down": row["bytes_received"] // max(1, row["calls"]),
         "bytes_sent_total": wire["bytes_sent"],
         "bytes_received_total": wire["bytes_received"],
+        # per-tier rollup (grpc/uds/inproc): co-located fast-path runs
+        # must show ~0 bytes under "grpc" here
+        "transports": wire.get("transports", {}),
     }
     return n_records * epochs / elapsed, worker, elapsed
 
@@ -162,6 +185,31 @@ def jax_tree_map(f, tree):
     import jax
 
     return jax.tree_util.tree_map(f, tree)
+
+
+def _probe_link_mbps() -> float:
+    """h2d link-bandwidth probe, run UNCONDITIONALLY around every
+    window run. BENCH_r05 shipped `link_mbps_per_run: []` /
+    `headline_link_mbps: null` because the probe hid behind an
+    `if on_tpu:` gate — the weather-normalization column the protocol
+    promises was silently empty. The probe is a plain jax.device_put
+    timing (bench_resnet.measure_link_bandwidth), which works on any
+    backend; if it cannot produce a positive number the bench FAILS
+    rather than report a run without its link weather."""
+    try:
+        from bench_resnet import measure_link_bandwidth
+
+        mbps = float(measure_link_bandwidth())
+    except Exception as e:
+        raise RuntimeError(
+            f"link-bandwidth probe failed ({e!r}): refusing to report "
+            "a window run without link accounting"
+        ) from e
+    if not mbps > 0:
+        raise RuntimeError(
+            f"link-bandwidth probe returned non-positive {mbps!r}"
+        )
+    return mbps
 
 
 def _tpu_alive(timeout: float = 180.0) -> bool:
@@ -256,10 +304,7 @@ def main():
     max_attempts = 2 if on_tpu else 1
     attempt = 0
     while attempt < max_attempts:
-        if on_tpu:
-            from bench_resnet import measure_link_bandwidth
-
-            link_before = measure_link_bandwidth()
+        link_before = _probe_link_mbps()
         imgs_per_sec, worker, elapsed = run_job(
             model_module,
             path,
@@ -281,10 +326,7 @@ def main():
         # of the last 3 tasks, so one lucky final window can't pass an
         # oscillating run. TPU only: the CPU smoke run is 16 steps,
         # all inside the 200-step LR warmup.
-        if on_tpu:
-            link_mbps.append(
-                round(max(link_before, measure_link_bandwidth()), 1)
-            )
+        link_mbps.append(round(max(link_before, _probe_link_mbps()), 1))
         losses = worker.task_losses
         assert losses, "no training tasks ran"
         run_tail = statistics.median(losses[-3:])
@@ -422,6 +464,91 @@ def main():
     os.environ.pop("EDL_BET_PREFETCH", None)
     dfm_recs_per_sec = dfm_pair["prefetch_on"]
 
+    # ---- compressed sync plane: int8 + top-k vs the f32 wire ----
+    # Short f32 run first: bytes-per-sync is shape-determined, not
+    # record-count-determined, so a 2-task run prices the f32 wire.
+    short_n = records_per_task * 2 if on_tpu else n_records
+    _f32_imgs, f32_worker, _ = run_job(
+        model_module,
+        path,
+        short_n,
+        minibatch=minibatch,
+        records_per_task=records_per_task,
+        epochs=1,
+        local_updates=window,
+        grads_to_wait=1,
+    )
+    # Full compressed run, convergence-gated exactly like the bf16
+    # headline: top-k 5% sparsification with int8-quantized survivors,
+    # both errors folded into the worker's EF residual.
+    comp_imgs, comp_worker, comp_elapsed = run_job(
+        model_module,
+        path,
+        n_records,
+        minibatch=minibatch,
+        records_per_task=records_per_task,
+        epochs=1,
+        local_updates=window,
+        grads_to_wait=1,
+        sync_dtype="int8",
+        sync_compress="topk:0.05",
+    )
+    comp_tail = statistics.median(comp_worker.task_losses[-3:])
+    if on_tpu:
+        assert comp_tail < 1.5, (
+            f"compressed run did not converge: last-3-task median "
+            f"{comp_tail:.3f}"
+        )
+    f32_up = f32_worker.wire_summary["bytes_per_sync_up"]
+    comp_up = comp_worker.wire_summary["bytes_per_sync_up"]
+    compress_ratio = round(f32_up / max(1, comp_up), 2)
+    print(
+        f"bench[window int8+topk:0.05]: {n_records} imgs in "
+        f"{comp_elapsed:.1f}s = {comp_imgs:.1f} img/s; tail loss "
+        f"{comp_tail:.3f}; {comp_up} B/sync up vs {f32_up} f32 "
+        f"({compress_ratio}x smaller)",
+        file=sys.stderr,
+    )
+
+    # ---- transport tiers: co-located fast paths vs gRPC ----
+    # Same short job over the inproc and uds tiers; the per-tier wire
+    # rollup must show the timed region riding the fast path — any
+    # bytes under "grpc" mean the tier silently fell back.
+    tier_runs = {}
+    for tier in ("inproc", "uds"):
+        t_imgs, t_worker, _ = run_job(
+            model_module,
+            path,
+            short_n,
+            minibatch=minibatch,
+            records_per_task=records_per_task,
+            epochs=1,
+            local_updates=window,
+            grads_to_wait=1,
+            transport=tier,
+        )
+        tr = t_worker.wire_summary["transports"]
+        grpc_row = tr.get("grpc") or {}
+        grpc_bytes = (
+            grpc_row.get("bytes_sent", 0) + grpc_row.get("bytes_received", 0)
+        )
+        assert grpc_bytes == 0, (
+            f"{tier} tier leaked {grpc_bytes} bytes onto gRPC — "
+            "co-located fast path silently fell back"
+        )
+        tier_runs[tier] = {
+            "images_per_sec": round(t_imgs, 1),
+            "bytes_per_sync_up": t_worker.wire_summary["bytes_per_sync_up"],
+            "grpc_bytes_total": grpc_bytes,
+            "transports": tr,
+        }
+        print(
+            f"bench[window transport={tier}]: {t_imgs:.1f} img/s; "
+            f"{t_worker.wire_summary['bytes_per_sync_up']} B/sync up on "
+            f"the {tier} tier; grpc bytes {grpc_bytes}",
+            file=sys.stderr,
+        )
+
     # ---- north-star model: ResNet-50 chip throughput ----
     # (bench_resnet.py holds the full story incl. the elastic-runtime
     # number and the link physics; the chip number rides the driver's
@@ -472,6 +599,22 @@ def main():
                 "window_wire": worker.wire_summary,
                 "per_step_wire": ps_worker.wire_summary,
                 "sync_dtype": "bfloat16",
+                # compressed sync plane: int8 per-chunk quantization +
+                # top-k 5% sparsification (EF-folded), priced against a
+                # same-shape f32 run and convergence-gated on TPU
+                "wire_f32_baseline": f32_worker.wire_summary,
+                "wire_compressed": {
+                    **comp_worker.wire_summary,
+                    "sync_dtype": "int8",
+                    "sync_compress": "topk:0.05",
+                    "images_per_sec": round(comp_imgs, 1),
+                    "tail_loss": round(comp_tail, 4),
+                },
+                "compressed_bytes_per_sync_ratio_vs_f32": compress_ratio,
+                # co-located transport fast paths: each run's wire
+                # rollup is split per tier; grpc_bytes_total == 0 is
+                # asserted above (no silent fallback)
+                "transport_tiers": tier_runs,
                 "deepfm_sparse_window_records_per_sec": dfm_recs_per_sec,
                 "deepfm_bet_prefetch_ab": dfm_pair,
                 "resnet50_chip": resnet,
@@ -541,7 +684,13 @@ def main():
                     "is the north-star model's device-resident full "
                     "train step (see bench_resnet.py for the "
                     "elastic-runtime variant and the input-bandwidth "
-                    "physics)"
+                    "physics). wire_compressed is the int8+topk:0.05 "
+                    "EF sync plane priced against wire_f32_baseline "
+                    "(same job shape, f32 wire), convergence-gated "
+                    "like the headline; transport_tiers re-runs the "
+                    "short window job over the co-located inproc and "
+                    "uds fast paths with the per-tier byte split "
+                    "(grpc bytes asserted 0 — no silent fallback)"
                 ),
             }
         )

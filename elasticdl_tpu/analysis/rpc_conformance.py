@@ -43,6 +43,25 @@ Checks:
                            descriptor key outside the declaration, or
                            never reads a declared key — either way the
                            wire contract and the code have diverged
+- ``transport-surface-drift``  a ``*Transport`` class (rpc/transport.py
+                           tier registry) whose ``call`` signature
+                           deviates from ``(self, method, payload,
+                           timeout)`` or whose ``name`` is not a
+                           declared ``TRANSPORT_TIERS`` member — every
+                           tier must present the identical call surface
+                           so RpcClient can swap tiers blindly
+- ``transport-chaos-bypass``   a ``*Transport.call`` or
+                           ``ServerDispatcher.dispatch`` that does not
+                           invoke BOTH ``transport_faults_before`` and
+                           ``transport_faults_after`` — the fast path
+                           would silently bypass FaultPlan injection
+                           and the chaos e2e exactness guarantees
+- ``transport-dispatch-bypass``  a listener class co-located with the
+                           transport tiers (``*Server`` in the module
+                           declaring them) that never routes through
+                           ``ServerDispatcher.dispatch`` — the only way
+                           every tier provably serves the same method
+                           table as ``RpcServer.handlers()``
 
 Request dicts are resolved from dict literals plus same-function
 dataflow (``req = {...}`` followed by ``req["k"] = v`` /
@@ -566,6 +585,210 @@ def _frame_descriptor_findings(ctx: AnalysisContext) -> List[Finding]:
     return findings
 
 
+# -- transport tier registry --------------------------------------------------
+
+_TIERS_NAME = "TRANSPORT_TIERS"
+_DISPATCHER_CLASS = "ServerDispatcher"
+_CHAOS_HOOKS = ("transport_faults_before", "transport_faults_after")
+_TRANSPORT_CALL_ARGS = ["self", "method", "payload", "timeout"]
+
+
+def _called_names(func: ast.FunctionDef) -> Set[str]:
+    """Bare and attribute callee names invoked anywhere in `func`."""
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+        elif isinstance(node.func, ast.Attribute):
+            out.add(node.func.attr)
+    return out
+
+
+def _transport_findings(ctx: AnalysisContext) -> List[Finding]:
+    """Cross-check the transport tier registry (see module docstring):
+    identical client call surface per tier, chaos hooks on every tier's
+    send/receive path, and all listeners funneling through the shared
+    dispatcher so no tier can drift from RpcServer.handlers()."""
+    findings: List[Finding] = []
+
+    def _module_consts(tree) -> Dict[str, str]:
+        """Module-level str constants (TRANSPORT_UDS = "uds") so both
+        the TRANSPORT_TIERS tuple and a class attribute
+        `name = TRANSPORT_UDS` resolve to their tier strings."""
+        consts: Dict[str, str] = {}
+        for node in tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                s = _const_str(node.value)
+                if s is not None:
+                    consts[node.targets[0].id] = s
+        return consts
+
+    def _tier_set(node, consts) -> Optional[Set[str]]:
+        """Tuple/list/set of str constants OR module-const names."""
+        if not isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+            return None
+        out: Set[str] = set()
+        for el in node.elts:
+            s = _const_str(el)
+            if s is None and isinstance(el, ast.Name):
+                s = consts.get(el.id)
+            if s is None:
+                return None
+            out.add(s)
+        return out
+
+    declared_tiers: Optional[Set[str]] = None
+    for path, tree in ctx.trees():
+        consts = _module_consts(tree)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == _TIERS_NAME
+            ):
+                tiers = _tier_set(node.value, consts)
+                if tiers is not None:
+                    declared_tiers = tiers
+
+    for path, tree in ctx.trees():
+        consts = _module_consts(tree)
+
+        transports = [
+            n
+            for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef) and n.name.endswith("Transport")
+        ]
+        for cls in transports:
+            call = next(
+                (
+                    m
+                    for m in cls.body
+                    if isinstance(m, ast.FunctionDef) and m.name == "call"
+                ),
+                None,
+            )
+            if call is None:
+                findings.append(
+                    Finding(
+                        RULE, "transport-surface-drift", path, cls.lineno,
+                        f"transport class '{cls.name}' has no call() — "
+                        f"every tier must present the RpcClient call "
+                        f"surface",
+                    )
+                )
+            else:
+                argnames = [a.arg for a in call.args.args]
+                if argnames != _TRANSPORT_CALL_ARGS:
+                    findings.append(
+                        Finding(
+                            RULE, "transport-surface-drift", path,
+                            call.lineno,
+                            f"'{cls.name}.call' signature {argnames} != "
+                            f"{_TRANSPORT_CALL_ARGS} — tiers must be "
+                            f"swappable blind",
+                        )
+                    )
+                missing = [
+                    h for h in _CHAOS_HOOKS if h not in _called_names(call)
+                ]
+                if missing:
+                    findings.append(
+                        Finding(
+                            RULE, "transport-chaos-bypass", path,
+                            call.lineno,
+                            f"'{cls.name}.call' never invokes "
+                            f"{'/'.join(missing)} — this tier bypasses "
+                            f"client-side FaultPlan injection",
+                        )
+                    )
+            name_val = None
+            name_line = cls.lineno
+            for st in cls.body:
+                if (
+                    isinstance(st, ast.Assign)
+                    and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)
+                    and st.targets[0].id == "name"
+                ):
+                    name_line = st.lineno
+                    name_val = _const_str(st.value)
+                    if name_val is None and isinstance(st.value, ast.Name):
+                        name_val = consts.get(st.value.id)
+            if declared_tiers is not None and name_val not in declared_tiers:
+                findings.append(
+                    Finding(
+                        RULE, "transport-surface-drift", path, name_line,
+                        f"transport class '{cls.name}' name "
+                        f"{name_val!r} is not a declared {_TIERS_NAME} "
+                        f"member — WireStats rows for it would be "
+                        f"untracked",
+                    )
+                )
+
+        for cls in [
+            n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+        ]:
+            if cls.name == _DISPATCHER_CLASS:
+                disp = next(
+                    (
+                        m
+                        for m in cls.body
+                        if isinstance(m, ast.FunctionDef)
+                        and m.name == "dispatch"
+                    ),
+                    None,
+                )
+                if disp is not None:
+                    missing = [
+                        h
+                        for h in _CHAOS_HOOKS
+                        if h not in _called_names(disp)
+                    ]
+                    if missing:
+                        findings.append(
+                            Finding(
+                                RULE, "transport-chaos-bypass", path,
+                                disp.lineno,
+                                f"'{_DISPATCHER_CLASS}.dispatch' never "
+                                f"invokes {'/'.join(missing)} — the fast "
+                                f"paths bypass server-side FaultPlan "
+                                f"injection",
+                            )
+                        )
+            # listeners beside the tiers must serve through the shared
+            # dispatcher — the only proof every tier answers the same
+            # method table as RpcServer.handlers()
+            if (
+                transports
+                and cls.name.endswith("Server")
+                and cls.name != _DISPATCHER_CLASS
+            ):
+                routes = any(
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "dispatch"
+                    for node in ast.walk(cls)
+                )
+                if not routes:
+                    findings.append(
+                        Finding(
+                            RULE, "transport-dispatch-bypass", path,
+                            cls.lineno,
+                            f"listener '{cls.name}' never routes through "
+                            f"{_DISPATCHER_CLASS}.dispatch — its method "
+                            f"table can drift from RpcServer.handlers()",
+                        )
+                    )
+    return findings
+
+
 # -- the rule ----------------------------------------------------------------
 
 
@@ -679,6 +902,9 @@ def run(ctx: AnalysisContext) -> List[Finding]:
 
     # codec v2 frame-descriptor contract (see module docstring)
     findings.extend(_frame_descriptor_findings(ctx))
+
+    # transport tier registry: call surface, chaos wiring, dispatcher
+    findings.extend(_transport_findings(ctx))
 
     # WIRE_SCHEMAS <-> handlers: exact match both ways
     if schemas and handlers:

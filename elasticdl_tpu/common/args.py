@@ -74,11 +74,20 @@ def add_model_spec_args(parser: argparse.ArgumentParser):
     )
     parser.add_argument(
         "--sync_dtype", default="",
-        choices=("", "float32", "bfloat16", "bf16"),
-        help="sync-plane wire dtype: bf16 sends window deltas / "
-        "per-step grads as bfloat16 with an error-feedback residual "
-        "held on the worker (converges to the f32 trajectory; "
-        "default float32 = bit-exact). EDL_SYNC_DTYPE overrides.",
+        choices=("", "float32", "bfloat16", "bf16", "int8"),
+        help="sync-plane wire dtype: bf16/int8 send window deltas / "
+        "per-step grads quantized (int8 = per-chunk scaled) with an "
+        "error-feedback residual held on the worker (converges to the "
+        "f32 trajectory; default float32 = bit-exact). "
+        "EDL_SYNC_DTYPE overrides.",
+    )
+    parser.add_argument(
+        "--sync_compress", default="",
+        help="sync-plane delta sparsification: topk:<ratio> ships only "
+        "the ratio*n largest-magnitude window-delta entries as "
+        "(indices, values) frames, error-feedback corrected; composes "
+        "with --sync_dtype int8/bf16 for the values (default off). "
+        "EDL_SYNC_COMPRESS overrides.",
     )
     parser.add_argument("--log_level", default="INFO")
     parser.add_argument(
@@ -471,6 +480,8 @@ def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:
     ]
     if getattr(args, "sync_dtype", ""):
         argv += ["--sync_dtype", args.sync_dtype]
+    if getattr(args, "sync_compress", ""):
+        argv += ["--sync_compress", args.sync_compress]
     for flag in (
         "model_params",
         "dataset_fn",

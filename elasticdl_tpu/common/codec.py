@@ -66,6 +66,8 @@ except ImportError:  # pragma: no cover
 _ND_KEY = "__nd__"
 _IR_KEY = "__ir__"
 _TUPLE_KEY = "__tp__"
+_QD_KEY = "__qd__"
+_SD_KEY = "__sd__"
 
 #: v2 frame constants. 0xC1 is the one byte the msgpack spec reserves
 #: and never emits, so it unambiguously marks a framed payload.
@@ -214,6 +216,186 @@ def as_f32(a: Any) -> np.ndarray:
 
 
 # --------------------------------------------------------------------------
+# Compressed delta wire forms: int8 per-chunk scaled quantization and
+# top-k sparsification. Both are BIASED compressors; senders fold the
+# compression error into an f32 error-feedback residual (worker-side,
+# same telescoping-bound machinery as the bf16 transport) so the
+# receiver can apply the decoded f32 delta exactly as if it were dense.
+
+#: Elements per int8 scale chunk. 2048 f32 elements quantize to 2048
+#: int8 bytes + one f32 scale — a fixed 0.05% scale overhead while
+#: keeping the max-magnitude scale local enough that one outlier only
+#: coarsens its own chunk.
+DEFAULT_INT8_CHUNK = 2048
+
+
+@dataclasses.dataclass
+class QuantizedDelta:
+    """An int8 per-chunk-scaled quantization of a dense f32 vector.
+
+    Chunk c (elements [c*chunk, (c+1)*chunk) in ABSOLUTE coordinates)
+    was quantized as q = clip(round(v / scale[c]), -127, 127) with
+    scale[c] = max|v| / 127 over the chunk (0-chunks get scale 1.0 so
+    dequantize is exact zeros). `offset` is the absolute position of
+    q[0] in the source vector; keeping chunk boundaries absolute makes
+    per-shard slicing exact without chunk alignment: a slice reuses the
+    parent's scales for the chunks it overlaps.
+    """
+
+    q: np.ndarray  # [n] int8
+    scale: np.ndarray  # [nchunks] f32, chunks offset//chunk ..
+    chunk: int
+    offset: int = 0
+
+    def __post_init__(self):
+        self.q = np.asarray(self.q)
+        self.scale = np.asarray(self.scale)
+        self.chunk = int(self.chunk)
+        self.offset = int(self.offset)
+
+    @property
+    def n(self) -> int:
+        return int(self.q.size)
+
+    def slice(self, start: int, stop: int) -> "QuantizedDelta":
+        """Sub-delta for local elements [start, stop) — the PS-shard
+        split. Scales slice to the overlapped absolute chunks."""
+        start, stop = int(start), int(stop)
+        abs_start = self.offset + start
+        first_chunk = self.offset // self.chunk
+        if stop <= start:
+            return QuantizedDelta(
+                q=self.q[:0], scale=self.scale[:0], chunk=self.chunk, offset=abs_start
+            )
+        lo = abs_start // self.chunk - first_chunk
+        hi = (self.offset + stop - 1) // self.chunk - first_chunk + 1
+        return QuantizedDelta(
+            q=self.q[start:stop],
+            scale=self.scale[lo:hi],
+            chunk=self.chunk,
+            offset=abs_start,
+        )
+
+    def dequantize(self) -> np.ndarray:
+        """Dense f32 reconstruction (q * scale-of-its-chunk)."""
+        if self.q.size == 0:
+            return np.zeros(0, dtype=np.float32)
+        first_chunk = self.offset // self.chunk
+        idx = (self.offset + np.arange(self.q.size)) // self.chunk - first_chunk
+        return self.q.astype(np.float32) * np.asarray(
+            self.scale, dtype=np.float32
+        )[idx]
+
+
+@dataclasses.dataclass
+class SparseDelta:
+    """A top-k sparsified dense vector: `values[j]` is the entry at
+    position `indices[j]` of a length-`n` vector whose other entries
+    are zero. Indices are LOCAL to this delta, sorted ascending and
+    unique, so a PS-shard slice is one searchsorted range. `values` is
+    either a dense array (f32/bf16) or a nested QuantizedDelta over the
+    packed values — the topk+int8 composition."""
+
+    indices: np.ndarray  # [k] int, sorted ascending, in [0, n)
+    values: Any  # [k] ndarray or QuantizedDelta over the packed values
+    n: int
+
+    def __post_init__(self):
+        self.indices = np.asarray(self.indices)
+        if not np.issubdtype(self.indices.dtype, np.integer):
+            raise TypeError(f"SparseDelta indices must be integer, got {self.indices.dtype}")
+        if not isinstance(self.values, QuantizedDelta):
+            self.values = np.asarray(self.values)
+        self.n = int(self.n)
+
+    @property
+    def k(self) -> int:
+        return int(self.indices.size)
+
+    def slice(self, start: int, stop: int) -> "SparseDelta":
+        """Sub-delta covering local elements [start, stop), indices
+        rebased to the sub-range."""
+        start, stop = int(start), int(stop)
+        lo = int(np.searchsorted(self.indices, start, side="left"))
+        hi = int(np.searchsorted(self.indices, stop, side="left"))
+        values = (
+            self.values.slice(lo, hi)
+            if isinstance(self.values, QuantizedDelta)
+            else self.values[lo:hi]
+        )
+        return SparseDelta(
+            indices=self.indices[lo:hi] - start,
+            values=values,
+            n=max(0, stop - start),
+        )
+
+    def dense(self) -> np.ndarray:
+        """Dense f32 reconstruction (zeros with values scattered in)."""
+        out = np.zeros(self.n, dtype=np.float32)
+        vals = (
+            self.values.dequantize()
+            if isinstance(self.values, QuantizedDelta)
+            else as_f32(self.values)
+        )
+        out[self.indices] = vals
+        return out
+
+
+def quantize_int8(vec, chunk: int = DEFAULT_INT8_CHUNK) -> QuantizedDelta:
+    """Host-side int8 per-chunk quantization of a dense f32 vector
+    (offset 0). The worker hot path quantizes ON DEVICE with the same
+    math (worker._ef_compress_delta); this is the host mirror used by
+    the PS restore/test paths and as the spec the device math is tested
+    against."""
+    vec = np.asarray(vec, dtype=np.float32).ravel()
+    n = vec.size
+    chunk = int(chunk)
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    nchunks = -(-n // chunk) if n else 0
+    pad = nchunks * chunk - n
+    padded = np.pad(vec, (0, pad)) if pad else vec
+    blocks = padded.reshape(max(nchunks, 0), chunk) if nchunks else padded.reshape(0, chunk)
+    scale = np.abs(blocks).max(axis=1) / 127.0 if nchunks else np.zeros(0, dtype=np.float32)
+    scale = np.where(scale > 0, scale, 1.0).astype(np.float32)
+    q = np.clip(np.rint(blocks / scale[:, None]), -127, 127).astype(np.int8)
+    return QuantizedDelta(q=q.reshape(-1)[:n], scale=scale, chunk=chunk)
+
+
+def delta_length(obj: Any) -> int:
+    """Dense length of a wire delta regardless of its compression."""
+    if isinstance(obj, QuantizedDelta):
+        return obj.n
+    if isinstance(obj, SparseDelta):
+        return obj.n
+    return int(np.asarray(obj).size)
+
+
+def slice_delta(obj: Any, start: int, stop: int) -> Any:
+    """Elements [start, stop) of a wire delta, preserving its
+    compression — the PS-shard fan-out split (ps_client.push_delta)."""
+    if isinstance(obj, (QuantizedDelta, SparseDelta)):
+        return obj.slice(start, stop)
+    return np.asarray(obj)[start:stop]
+
+
+def delta_to_f32(obj: Any, n: int | None = None) -> np.ndarray:
+    """Decode any wire delta form to a dense f32 vector: dense arrays
+    pass through `as_f32` (f32 stays a view), QuantizedDelta
+    dequantizes, SparseDelta densifies. The single decode point for the
+    PS/master apply sites — compression never leaks past it."""
+    if isinstance(obj, QuantizedDelta):
+        out = obj.dequantize()
+    elif isinstance(obj, SparseDelta):
+        out = obj.dense()
+    else:
+        out = as_f32(obj)
+    if n is not None and out.size != n:
+        raise ValueError(f"delta length {out.size} != expected {n}")
+    return out
+
+
+# --------------------------------------------------------------------------
 # v1 payload form: arrays embedded as msgpack bins ({"d","s","b"})
 
 
@@ -241,6 +423,23 @@ def _default(obj: Any) -> Any:
             "v": _encode_array(obj.values),
             "i": _encode_array(obj.indices),
         }
+    if isinstance(obj, QuantizedDelta):
+        return {
+            _QD_KEY: True,
+            "q": _encode_array(obj.q),
+            "sc": _encode_array(obj.scale),
+            "c": obj.chunk,
+            "f": obj.offset,
+        }
+    if isinstance(obj, SparseDelta):
+        # values may be an ndarray or a nested QuantizedDelta; either
+        # way packb routes it back through _default
+        return {
+            _SD_KEY: True,
+            "i": _encode_array(obj.indices),
+            "v": obj.values,
+            "n": obj.n,
+        }
     if isinstance(obj, np.ndarray):
         return {_ND_KEY: True, **_encode_array(obj)}
     if isinstance(obj, (np.floating, np.integer, np.bool_)):
@@ -258,6 +457,17 @@ def _object_hook(m: dict) -> Any:
         return _decode_array(m)
     if _IR_KEY in m:
         return IndexedRows(values=_decode_array(m["v"]), indices=_decode_array(m["i"]))
+    if _QD_KEY in m:
+        return QuantizedDelta(
+            q=_decode_array(m["q"]),
+            scale=_decode_array(m["sc"]),
+            chunk=m["c"],
+            offset=m["f"],
+        )
+    if _SD_KEY in m:
+        # "v" was decoded bottom-up (ndarray via _ND_KEY or nested
+        # QuantizedDelta via _QD_KEY)
+        return SparseDelta(indices=_decode_array(m["i"]), values=m["v"], n=m["n"])
     if _TUPLE_KEY in m:
         return tuple(m[_TUPLE_KEY])
     return m
@@ -312,6 +522,21 @@ def _build_frame_tree(obj: Any, builder: _FrameBuilder) -> Any:
             _IR_KEY: True,
             "v": {_ND_KEY: True, **_frame_descriptor(obj.values, builder)},
             "i": {_ND_KEY: True, **_frame_descriptor(obj.indices, builder)},
+        }
+    if isinstance(obj, QuantizedDelta):
+        return {
+            _QD_KEY: True,
+            "q": {_ND_KEY: True, **_frame_descriptor(obj.q, builder)},
+            "sc": {_ND_KEY: True, **_frame_descriptor(obj.scale, builder)},
+            "c": obj.chunk,
+            "f": obj.offset,
+        }
+    if isinstance(obj, SparseDelta):
+        return {
+            _SD_KEY: True,
+            "i": {_ND_KEY: True, **_frame_descriptor(obj.indices, builder)},
+            "v": _build_frame_tree(obj.values, builder),
+            "n": obj.n,
         }
     if isinstance(obj, np.ndarray):
         return {_ND_KEY: True, **_frame_descriptor(obj, builder)}
@@ -369,6 +594,12 @@ def _loads_frame(data) -> Any:
             # descriptors carry _ND_KEY, so msgpack's bottom-up hooks
             # already turned v/i into arrays
             return IndexedRows(values=m["v"], indices=m["i"])
+        if _QD_KEY in m:
+            return QuantizedDelta(
+                q=m["q"], scale=m["sc"], chunk=m["c"], offset=m["f"]
+            )
+        if _SD_KEY in m:
+            return SparseDelta(indices=m["i"], values=m["v"], n=m["n"])
         if _TUPLE_KEY in m:
             return tuple(m[_TUPLE_KEY])
         return m

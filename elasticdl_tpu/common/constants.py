@@ -68,6 +68,9 @@ ENV_RPC_BACKOFF = "EDL_RPC_BACKOFF"
 ENV_RPC_SEED = "EDL_RPC_SEED"
 ENV_SYNC_DEPTH = "EDL_SYNC_DEPTH"
 ENV_SYNC_DTYPE = "EDL_SYNC_DTYPE"
+ENV_SYNC_COMPRESS = "EDL_SYNC_COMPRESS"
+ENV_TRANSPORT = "EDL_TRANSPORT"
+ENV_UDS_DIR = "EDL_UDS_DIR"
 ENV_OPT_MIRROR_SECS = "EDL_OPT_MIRROR_SECS"
 ENV_BET_PREFETCH = "EDL_BET_PREFETCH"
 ENV_BENCH_MFU = "EDL_BENCH_MFU"
@@ -101,9 +104,27 @@ ENV_REGISTRY = {
         "default 2)"
     ),
     ENV_SYNC_DTYPE: (
-        "sync-plane wire dtype: bf16 sends window deltas / per-step "
-        "grads as bfloat16 with error-feedback residuals held on the "
-        "worker (default float32 = bit-exact)"
+        "sync-plane wire dtype: bf16 or int8 sends window deltas / "
+        "per-step grads quantized with error-feedback residuals held "
+        "on the worker (default float32 = bit-exact)"
+    ),
+    ENV_SYNC_COMPRESS: (
+        "sync-plane delta sparsification: topk:<ratio> ships only the "
+        "ratio*n largest-magnitude window-delta entries as "
+        "(indices, values) frames, error-feedback corrected; composes "
+        "with EDL_SYNC_DTYPE int8/bf16 for the values (default off)"
+    ),
+    ENV_TRANSPORT: (
+        "RPC transport tier: grpc (default), uds (Unix-domain-socket "
+        "fast path to co-located shards), inproc (same-interpreter "
+        "direct dispatch), or auto (prefer inproc, then uds, then "
+        "grpc); non-grpc tiers apply when the endpoint resolves local, "
+        "else fall back to grpc (rpc/transport.py)"
+    ),
+    ENV_UDS_DIR: (
+        "directory for the UDS fast-path sockets (edl-uds-<port>.sock; "
+        "default: the system temp dir — must be shared by co-located "
+        "processes)"
     ),
     ENV_OPT_MIRROR_SECS: (
         "recovery plane: seconds between PS optimizer-state mirror "

@@ -214,12 +214,13 @@ class PSShardServicer:
         equality rejection is refused at configuration time (module
         docstring) so an accept can never be torn across shards."""
         self._check_epoch(req)
-        # no-copy when the wire already carried f32: the decoded
-        # frombuffer view is applied as-is (it is read-only, and every
-        # consumer below uses it only as a ufunc operand); a bf16 wire
-        # payload (EDL_SYNC_DTYPE=bf16) widens to f32 here — shard math
-        # is always full precision
-        grad = codec.as_f32(req["grad"])
+        # no-copy when the wire already carried a dense f32 array: the
+        # decoded frombuffer view is applied as-is (it is read-only,
+        # and every consumer below uses it only as a ufunc operand).
+        # Compressed wire forms decode here and NOWHERE else: bf16
+        # widens, int8 (QuantizedDelta) dequantizes — shard math is
+        # always full precision
+        grad = codec.delta_to_f32(req["grad"])
         report_version = int(req.get("version", -1))
         with self._lock:
             if self._vec is None:
@@ -281,7 +282,11 @@ class PSShardServicer:
                     "vec": self._wire_vec(req),
                     "duplicate": True,
                 }
-            delta = codec.as_f32(req["delta"])
+            # dense f32 passes through as a view; bf16 widens; int8 /
+            # top-k (QuantizedDelta / SparseDelta slices) decode to the
+            # dense f32 slice here — the compression never leaks into
+            # the apply math
+            delta = codec.delta_to_f32(req["delta"])
             if delta.shape != self._vec.shape:
                 raise ValueError(
                     f"delta slice shape {delta.shape} != {self._vec.shape}"

@@ -379,7 +379,12 @@ class MasterServicer:
             if self._params is None:
                 raise ValueError("gradient reported before model init")
             if grads is None and req.get("gradient_flat") is not None:
-                grads = self._unravel_model(req["gradient_flat"])
+                # delta_to_f32: the flat gradient may arrive bf16 or
+                # int8-quantized (codec.QuantizedDelta) from the
+                # worker's EF plane; decode before unraveling
+                grads = self._unravel_model(
+                    codec.delta_to_f32(req["gradient_flat"])
+                )
             staleness = self._version - report_version
             if not self._use_async and staleness > self._staleness_window:
                 # stale: reject AND piggyback the fresh model so the
@@ -512,7 +517,10 @@ class MasterServicer:
                 staleness = self._version - base_version
                 if staleness > self._staleness_window:
                     scale = self._staleness_window / float(staleness)
-            delta = self._unravel_model(req["delta_flat"])
+            # decode the worker's wire form first: dense f32 is a
+            # pass-through view; bf16 / int8 / top-k (QuantizedDelta /
+            # SparseDelta) decode to the dense f32 vector here
+            delta = self._unravel_model(codec.delta_to_f32(req["delta_flat"]))
             self._params = jax.tree_util.tree_map(
                 lambda p, d: p + scale * np.asarray(d, dtype=np.float32),
                 self._params,

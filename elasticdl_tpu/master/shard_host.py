@@ -60,6 +60,15 @@ def spawn_shard_processes(
 
         role = "kv" if "kv" in entry_module.rsplit(".", 1)[-1] else "ps"
         env.update(chaos_env_for(role, i))
+        # transport tiers: EDL_TRANSPORT inherits via the env copy, but
+        # the UDS socket DIR must be pinned explicitly — parent and
+        # shard default to tempfile.gettempdir() independently, and a
+        # TMPDIR divergence would silently strand the sockets in two
+        # places (clients fall back to grpc, masking the fast path)
+        from elasticdl_tpu.common.constants import ENV_UDS_DIR
+        from elasticdl_tpu.rpc import transport as _transport
+
+        env.setdefault(ENV_UDS_DIR, _transport.uds_dir())
         import elasticdl_tpu
 
         pkg_root = os.path.dirname(os.path.dirname(elasticdl_tpu.__file__))

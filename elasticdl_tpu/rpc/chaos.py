@@ -257,6 +257,48 @@ def _crash(method: str, when: str):
     os._exit(CHAOS_CRASH_EXIT_CODE)
 
 
+def transport_faults_before(
+    plan: Optional[FaultPlan], method: str, side: str
+) -> List[Fault]:
+    """Pre-call half of the interceptor fault semantics for non-gRPC
+    transports (rpc/transport.py): latency sleeps, crash-before exits,
+    error raises InjectedRpcError with the same status code the gRPC
+    interceptor would carry. Returns the deferred drop/crash-after
+    faults; the caller MUST run the call to completion and then pass
+    them to `transport_faults_after` — skipping that half silently
+    weakens drops into errors-before (the easy failure shape)."""
+    if plan is None:
+        return []
+    fired = plan.actions_for(method, side)
+    after: List[Fault] = []
+    for f in fired:
+        if f.kind == "latency":
+            logger.info("chaos: +%.0fms latency on %s", f.latency_ms, method)
+            time.sleep(f.latency_ms / 1000.0)
+        elif f.kind == "crash" and f.when == "before":
+            _crash(method, "before")
+        elif f.kind == "error":
+            logger.info("chaos: injecting %s on %s", f.code, method)
+            raise InjectedRpcError(_CODES[f.code], f"chaos: {method}")
+        elif f.kind in ("drop", "crash"):
+            after.append(f)
+    return after
+
+
+def transport_faults_after(after: List[Fault], method: str) -> None:
+    """Post-call half: the call COMPLETED (state applied); crash-after
+    exits, a drop withholds the response as UNAVAILABLE — identical to
+    both interceptors' after-path."""
+    for f in after:
+        if f.kind == "crash":
+            _crash(method, "after")
+    if after:
+        logger.info("chaos: dropping response of %s", method)
+        raise InjectedRpcError(
+            grpc.StatusCode.UNAVAILABLE, f"chaos drop: {method}"
+        )
+
+
 class _ClientChaosInterceptor(grpc.UnaryUnaryClientInterceptor):
     def __init__(self, plan: FaultPlan):
         self._plan = plan

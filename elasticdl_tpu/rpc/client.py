@@ -9,6 +9,15 @@ a per-endpoint `CircuitBreaker`, and the channel is wrapped with the
 process's chaos interceptors when `EDL_CHAOS_SPEC` is set — so fault
 injection exercises exactly the production path (see rpc/chaos.py,
 docs/fault_model.md).
+
+When `EDL_TRANSPORT` enables a fast path and the endpoint resolves
+co-located, the attempt routes the packed codec frame over the selected
+tier (in-process dispatch or a Unix-domain socket, rpc/transport.py)
+INSIDE the same policy/breaker envelope, with the same FaultPlan
+applied by the transport — tier selection changes how bytes move, never
+the failure semantics. WireStats rows carry the tier so bytes-per-sync
+distinguishes wire bytes from co-located ones (inproc counts calls but
+zero bytes).
 """
 
 from __future__ import annotations
@@ -46,6 +55,12 @@ class RpcClient:
                 channel = grpc.intercept_channel(channel, *interceptors)
         self._channel = channel
         self._service = service_name
+        # fast-path tier for co-located endpoints (None = plain gRPC).
+        # The transport shares `plan` with the interceptors above, so
+        # chaos counters advance identically whichever tier serves.
+        from elasticdl_tpu.rpc import transport as transport_mod
+
+        self._transport = transport_mod.select_transport(addr, fault_plan=plan)
         self._policy = policy if policy is not None else RetryPolicy.from_env()
         self._breaker = breaker if breaker is not None else CircuitBreaker(addr)
         self._calls: dict[str, Any] = {}
@@ -80,7 +95,24 @@ class RpcClient:
             idempotent = method in IDEMPOTENT_METHODS
         payload = messages.pack(request if request is not None else {})
 
+        transport = self._transport
+
         def attempt(remaining):
+            if transport is not None:
+                inproc = transport.name == "inproc"
+                self.wire.record(
+                    method,
+                    sent=0 if inproc else len(payload),
+                    transport=transport.name,
+                    calls=1 if inproc else None,
+                )
+                resp_bytes = transport.call(method, payload, remaining)
+                self.wire.record(
+                    method,
+                    received=0 if inproc else len(resp_bytes),
+                    transport=transport.name,
+                )
+                return resp_bytes
             self.wire.record(method, sent=len(payload))
             resp_bytes = stub(payload, timeout=remaining)
             self.wire.record(method, received=len(resp_bytes))

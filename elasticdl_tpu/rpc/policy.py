@@ -116,32 +116,57 @@ class WireStats:
     the policy layer is the one place every RPC already flows through,
     so the counters live next to the retry/breaker state.
 
-    Counters are payload bytes as handed to / received from gRPC
-    (post-codec, pre-HTTP/2 framing): exactly the bytes the codec
+    Counters are payload bytes as handed to / received from the
+    transport (post-codec, pre-framing): exactly the bytes the codec
     controls, which is what the bf16-vs-f32 and v1-vs-v2 comparisons
-    need. Thread-safe; snapshot() returns plain dicts for stats()/bench
-    JSON surfaces."""
+    need. Each record carries the transport TIER that moved the bytes
+    ("grpc" / "uds" / "inproc"), tallied separately so bytes-per-sync
+    honestly distinguishes a co-located fast path from the network: an
+    in-process call reports zero wire bytes but still counts its call
+    (callers pass `calls=1` explicitly there, since the default
+    heuristic counts a call per non-empty send). Thread-safe;
+    snapshot() returns plain dicts for stats()/bench JSON surfaces."""
 
     def __init__(self, endpoint: str = ""):
         self.endpoint = endpoint
         self._lock = threading.Lock()
         # method -> [bytes_sent, bytes_received, calls]
         self._methods: dict = {}
+        # transport tier -> [bytes_sent, bytes_received, calls]
+        self._transports: dict = {}
 
-    def record(self, method: str, sent: int = 0, received: int = 0):
+    def record(
+        self,
+        method: str,
+        sent: int = 0,
+        received: int = 0,
+        transport: str = "grpc",
+        calls=None,
+    ):
+        n = (1 if sent else 0) if calls is None else int(calls)
         with self._lock:
             row = self._methods.get(method)
             if row is None:
                 row = self._methods[method] = [0, 0, 0]
             row[0] += int(sent)
             row[1] += int(received)
-            row[2] += 1 if sent else 0
+            row[2] += n
+            trow = self._transports.get(transport)
+            if trow is None:
+                trow = self._transports[transport] = [0, 0, 0]
+            trow[0] += int(sent)
+            trow[1] += int(received)
+            trow[2] += n
 
     def snapshot(self) -> dict:
         with self._lock:
             methods = {
                 m: {"bytes_sent": r[0], "bytes_received": r[1], "calls": r[2]}
                 for m, r in self._methods.items()
+            }
+            transports = {
+                t: {"bytes_sent": r[0], "bytes_received": r[1], "calls": r[2]}
+                for t, r in self._transports.items()
             }
         return {
             "endpoint": self.endpoint,
@@ -151,11 +176,13 @@ class WireStats:
             ),
             "calls": sum(v["calls"] for v in methods.values()),
             "methods": methods,
+            "transports": transports,
         }
 
     def reset(self):
         with self._lock:
             self._methods.clear()
+            self._transports.clear()
 
 
 _wire_registry_lock = threading.Lock()
@@ -185,6 +212,7 @@ def aggregate_wire_snapshots(snapshots) -> dict:
     one {bytes_sent, bytes_received, methods} rollup: one logical push
     is num_shards slice sends, and "bytes per sync" means their SUM."""
     methods: dict = {}
+    transports: dict = {}
     for snap in snapshots:
         for m, row in snap["methods"].items():
             agg = methods.setdefault(
@@ -192,10 +220,18 @@ def aggregate_wire_snapshots(snapshots) -> dict:
             )
             for k in agg:
                 agg[k] += row[k]
+        # tolerate pre-transport-dimension snapshots (no "transports")
+        for t, row in snap.get("transports", {}).items():
+            agg = transports.setdefault(
+                t, {"bytes_sent": 0, "bytes_received": 0, "calls": 0}
+            )
+            for k in agg:
+                agg[k] += row[k]
     return {
         "bytes_sent": sum(v["bytes_sent"] for v in methods.values()),
         "bytes_received": sum(v["bytes_received"] for v in methods.values()),
         "methods": methods,
+        "transports": transports,
     }
 
 

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 import grpc
 import numpy as np
 
+from elasticdl_tpu.common import codec
 from elasticdl_tpu.master.ps_shard import slice_boundaries
 from elasticdl_tpu.rpc.client import RpcClient
 
@@ -199,17 +200,25 @@ class ShardedPS:
     ) -> Tuple[List[int], Dict[int, np.ndarray]]:
         """Window-delta fan-out. Returns (shard_versions,
         {shard_index: merged_slice}) — merged slices only for shards
-        whose version ran ahead of base+steps (or on want_model)."""
-        delta = np.asarray(delta)
-        if delta.size != self.n_params:
-            raise ValueError(f"delta size {delta.size} != {self.n_params}")
+        whose version ran ahead of base+steps (or on want_model).
+
+        `delta` may be a dense array or a compressed wire form
+        (codec.QuantizedDelta / codec.SparseDelta): `slice_delta`
+        splits either per shard without decompressing, so the wire
+        savings survive the fan-out and each shard decodes only its
+        slice (ps_shard applies via codec.delta_to_f32)."""
+        if not isinstance(delta, (codec.QuantizedDelta, codec.SparseDelta)):
+            delta = np.asarray(delta)
+        size = codec.delta_length(delta)
+        if size != self.n_params:
+            raise ValueError(f"delta size {size} != {self.n_params}")
 
         report_key = uuid.uuid4().hex  # shard-side dedup: retry-safe
 
         def do(c, i):
             s, e = self.bounds[i]
             req = {
-                "delta": delta[s:e],
+                "delta": codec.slice_delta(delta, s, e),
                 "steps": steps,
                 "base_version": base_versions[i],
                 "want_model": want_model,
@@ -244,10 +253,15 @@ class ShardedPS:
         dedup it while the relaunched shard (restored to the pre-push
         version) applies it — the partially-torn report heals to
         exactly-once on every slice, keeping version accounting
-        bit-exact across the failover."""
-        grad = np.asarray(grad)
-        if grad.size != self.n_params:
-            raise ValueError(f"grad size {grad.size} != {self.n_params}")
+        bit-exact across the failover.
+
+        Like push_delta, `grad` may arrive int8-quantized
+        (codec.QuantizedDelta) from the worker's EF grad path."""
+        if not isinstance(grad, (codec.QuantizedDelta, codec.SparseDelta)):
+            grad = np.asarray(grad)
+        size = codec.delta_length(grad)
+        if size != self.n_params:
+            raise ValueError(f"grad size {size} != {self.n_params}")
 
         # shard-side dedup: retry-safe (and replay-safe when the caller
         # pins the key)
@@ -256,7 +270,7 @@ class ShardedPS:
         def do(c, i):
             s, e = self.bounds[i]
             req = {
-                "grad": grad[s:e],
+                "grad": codec.slice_delta(grad, s, e),
                 "version": versions[i],
                 "return_model": return_model,
                 "report_key": report_key,

@@ -4,6 +4,12 @@ The reference compiles a .proto into stubs (elasticdl/Makefile:3-4); we
 instead register generic unary-unary handlers with identity serializers
 and run our own codec on the payloads — no codegen step, and the wire
 format supports bf16 and nested pytrees (see common/codec.py).
+
+Every server also serves the transport fast paths (rpc/transport.py):
+its handler table is registered in the in-process dispatch registry
+keyed by the bound port, and — when `EDL_TRANSPORT` enables it — a
+Unix-domain-socket listener shares the same `ServerDispatcher`, so
+chaos/fencing/abort classification is identical on every tier.
 """
 
 from __future__ import annotations
@@ -13,38 +19,27 @@ from typing import Callable, Dict
 
 import grpc
 
-from elasticdl_tpu.common import messages
 from elasticdl_tpu.common.constants import GRPC_OPTIONS, SERVICE_NAME
 from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.rpc import transport as transport_mod
+from elasticdl_tpu.rpc.policy import PolicyRpcError
 
 logger = get_logger(__name__)
 
 
-def _wrap(fn: Callable, method: str, wire) -> Callable:
-    def handler(request_bytes: bytes, context) -> bytes:
-        from elasticdl_tpu.rpc.fencing import EpochFencedError
+def _grpc_adapter(dispatcher, method: str) -> Callable:
+    """Thin gRPC shim over the shared ServerDispatcher: the dispatcher
+    raises PolicyRpcError with the status code the tier-independent
+    classifier chose; here that becomes context.abort."""
 
-        wire.record(method, received=len(request_bytes) if request_bytes else 0)
-        req = messages.unpack(request_bytes) if request_bytes else None
+    def handler(request_bytes: bytes, context) -> bytes:
         try:
-            resp = fn(req) if req is not None else fn({})
-        except EpochFencedError as e:
-            # fencing rejections are a protocol answer, not a bug:
-            # FAILED_PRECONDITION is non-retryable (policy.RETRYABLE_CODES)
-            # so the client re-resolves instead of re-sending (rpc/fencing.py)
-            logger.warning("RPC %s fenced: %s", fn.__name__, e)
-            detail = f"{type(e).__name__}: {e}".replace("\n", " ")[:256]
-            context.abort(grpc.StatusCode.FAILED_PRECONDITION, detail)
-        except Exception as e:
-            logger.exception("RPC handler %s failed", fn.__name__)
-            # abort() raises — nothing after it runs. Carry a sanitized
-            # one-line summary so the client can tell a shape mismatch
-            # from an uninitialized shard without reading server logs.
-            detail = f"{type(e).__name__}: {e}".replace("\n", " ")[:256]
-            context.abort(grpc.StatusCode.INTERNAL, detail)
-        resp_bytes = messages.pack(resp)
-        wire.record(method, sent=len(resp_bytes))
-        return resp_bytes
+            return dispatcher.dispatch(
+                method, request_bytes, transport_mod.TRANSPORT_GRPC
+            )
+        except PolicyRpcError as e:
+            # abort() raises — nothing after it runs
+            context.abort(e.code(), e.details())
 
     return handler
 
@@ -69,20 +64,25 @@ class RpcServer:
         from elasticdl_tpu.rpc.policy import WireStats
 
         self.wire = WireStats("server")
-        method_handlers = {
-            name: grpc.unary_unary_rpc_method_handler(
-                _wrap(fn, name, self.wire),
-                request_deserializer=None,
-                response_serializer=None,
-            )
-            for name, fn in handlers.items()
-        }
-        generic = grpc.method_handlers_generic_handler(service_name, method_handlers)
         # server-side chaos: active when EDL_CHAOS_SPEC is set (shard
-        # subprocesses inherit it) or a plan is passed in explicitly
+        # subprocesses inherit it) or a plan is passed in explicitly.
+        # The grpc tier injects via interceptors; the fast-path tiers
+        # via the dispatcher itself (exactly one layer per tier).
         from elasticdl_tpu.rpc import chaos
 
         plan = fault_plan if fault_plan is not None else chaos.FaultPlan.from_env()
+        self._dispatcher = transport_mod.ServerDispatcher(
+            handlers, self.wire, fault_plan=plan
+        )
+        method_handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                _grpc_adapter(self._dispatcher, name),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+            for name in handlers
+        }
+        generic = grpc.method_handlers_generic_handler(service_name, method_handlers)
         interceptors = tuple(plan.server_interceptors()) if plan else ()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
@@ -91,9 +91,23 @@ class RpcServer:
         )
         self._server.add_generic_rpc_handlers((generic,))
         self.port = self._server.add_insecure_port(f"[::]:{port}")
+        # co-located fast paths share the dispatcher (rpc/transport.py)
+        transport_mod.register_inproc(self.port, self._dispatcher)
+        self._uds = None
+        if transport_mod.server_fast_paths_enabled():
+            try:
+                self._uds = transport_mod.UdsServer(self.port, self._dispatcher)
+            except OSError as e:
+                logger.warning(
+                    "UDS fast path unavailable for port %s (%s); gRPC only",
+                    self.port,
+                    e,
+                )
 
     def start(self):
         self._server.start()
+        if self._uds is not None:
+            self._uds.start()
 
     def wire_stats(self) -> dict:
         """Per-method bytes_sent/bytes_received snapshot (see
@@ -101,6 +115,9 @@ class RpcServer:
         return self.wire.snapshot()
 
     def stop(self, grace: float = 0.5):
+        transport_mod.unregister_inproc(self.port)
+        if self._uds is not None:
+            self._uds.close()
         self._server.stop(grace)
 
     def wait(self):

@@ -1,0 +1,477 @@
+"""Transport tiers for the RPC plane: grpc / uds / inproc.
+
+The elastic window path is link-bound (docs/performance.md), yet a
+co-located PS shard pays full gRPC framing for bytes that never leave
+the host. This module adds two fast paths under the SAME call surface,
+selected per endpoint by `EDL_TRANSPORT`:
+
+- **uds** — a Unix-domain-socket byte protocol carrying codec frames
+  with a minimal length-prefixed header, skipping gRPC/HTTP-2 framing
+  entirely. The frame bytes go to `sendall` as-is (no re-serialization)
+  and the receiver hands the codec one contiguous buffer to build
+  `np.frombuffer` views over — the zero-copy contract of codec v2 holds
+  end to end.
+- **inproc** — when the serving `RpcServer` lives in the SAME
+  interpreter (bench/test mode, `PSShardGroup` inproc shards), the call
+  dispatches directly into the server's handler table: the packed frame
+  is passed by reference, no socket at all. WireStats records these
+  calls with zero wire bytes under the "inproc" tier.
+
+Every tier runs the identical server-side core, `ServerDispatcher`:
+chaos faults (rpc/chaos.py, via `transport_faults_before/after` — the
+exact interceptor semantics), EpochFencedError -> FAILED_PRECONDITION
+classification, and INTERNAL sanitization are applied once here, so the
+fault model and edl-verify's fencing conformance hold unchanged on the
+fast paths. Client-side chaos is likewise applied by each client
+transport with the same FaultPlan the gRPC interceptors use. The
+rpc-conformance lint cross-checks both wirings (transport-chaos-bypass)
+so a tier cannot silently bypass FaultPlan injection.
+
+Selection (`select_transport`) is conservative: a non-grpc tier is used
+only when the endpoint host resolves local AND the counterpart is
+reachable (a registered in-process dispatcher, or an existing socket
+file); otherwise the caller falls back to gRPC. `auto` prefers
+inproc > uds > grpc.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import tempfile
+import threading
+from typing import Callable, Dict, Optional
+
+import grpc
+
+from elasticdl_tpu.common import messages
+from elasticdl_tpu.common.constants import ENV_TRANSPORT, ENV_UDS_DIR
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.rpc.chaos import (
+    transport_faults_after,
+    transport_faults_before,
+)
+from elasticdl_tpu.rpc.policy import PolicyRpcError
+
+logger = get_logger(__name__)
+
+TRANSPORT_GRPC = "grpc"
+TRANSPORT_UDS = "uds"
+TRANSPORT_INPROC = "inproc"
+#: The tiers WireStats rows may carry; "auto" is a selection policy,
+#: not a tier.
+TRANSPORT_TIERS = (TRANSPORT_GRPC, TRANSPORT_UDS, TRANSPORT_INPROC)
+
+_LOCAL_HOSTS = frozenset(
+    {"localhost", "127.0.0.1", "[::1]", "::1", "0.0.0.0", "[::]", ""}
+)
+
+#: UDS request: u16 method length, u32 body length, then method utf-8
+#: and the codec frame.
+_REQ_HEADER = struct.Struct("<HI")
+#: UDS ok response: status 0, u32 body length, then the codec frame.
+_RESP_OK = struct.Struct("<BI")
+#: UDS error response: status 1, i32 grpc status-code value, u16 detail
+#: length, then the detail utf-8 — enough to rebuild the PolicyRpcError
+#: the gRPC tier would have surfaced.
+_RESP_ERR = struct.Struct("<BiH")
+
+_CODE_BY_VALUE = {c.value[0]: c for c in grpc.StatusCode}
+
+
+def transport_mode(env=None) -> str:
+    """The configured tier ("grpc"/"uds"/"inproc"/"auto"); unknown
+    values log once and mean grpc."""
+    env = os.environ if env is None else env
+    mode = (env.get(ENV_TRANSPORT, "") or TRANSPORT_GRPC).strip().lower()
+    if mode not in TRANSPORT_TIERS and mode != "auto":
+        logger.warning("unknown %s=%r; using grpc", ENV_TRANSPORT, mode)
+        return TRANSPORT_GRPC
+    return mode
+
+
+def server_fast_paths_enabled() -> bool:
+    """Whether RpcServer should open the UDS listener (the inproc
+    registry is always populated — it is a dict entry, not a socket)."""
+    return transport_mode() in (TRANSPORT_UDS, "auto")
+
+
+def uds_dir(env=None) -> str:
+    env = os.environ if env is None else env
+    return env.get(ENV_UDS_DIR) or tempfile.gettempdir()
+
+
+def uds_path_for(port: int) -> str:
+    """Socket path a server listening on gRPC `port` also serves; the
+    port number is the rendezvous, so clients derive the path from the
+    endpoint they already hold (GetPSConfig / shard_host endpoints)."""
+    return os.path.join(uds_dir(), f"edl-uds-{int(port)}.sock")
+
+
+def _sanitized_detail(e: BaseException) -> str:
+    return f"{type(e).__name__}: {e}".replace("\n", " ")[:256]
+
+
+class ServerDispatcher:
+    """The transport-independent server core: every tier's receive path
+    funnels through `dispatch`, so wire accounting, chaos injection,
+    fencing classification, and INTERNAL sanitization are applied
+    identically no matter how the bytes arrived.
+
+    For the grpc tier the chaos server interceptor already wraps the
+    handler, so dispatch applies server-side faults only for the fast
+    paths — exactly one injection layer per tier.
+    """
+
+    def __init__(self, handlers: Dict[str, Callable], wire, fault_plan=None):
+        self._handlers = dict(handlers)
+        self._wire = wire
+        self._plan = fault_plan
+
+    def methods(self) -> frozenset:
+        return frozenset(self._handlers)
+
+    def dispatch(self, method: str, request_bytes, transport: str) -> bytes:
+        after = []
+        if transport != TRANSPORT_GRPC:
+            after = transport_faults_before(self._plan, method, "server")
+        resp_bytes = self._invoke(method, request_bytes, transport)
+        # drop/crash-after fire with the handler APPLIED (same contract
+        # as the server interceptor: state changed, response withheld)
+        transport_faults_after(after, method)
+        return resp_bytes
+
+    def _invoke(self, method: str, request_bytes, transport: str) -> bytes:
+        from elasticdl_tpu.rpc.fencing import EpochFencedError
+
+        fn = self._handlers.get(method)
+        if fn is None:
+            raise PolicyRpcError(
+                grpc.StatusCode.UNIMPLEMENTED, f"no handler for {method}"
+            )
+        inproc = transport == TRANSPORT_INPROC
+        nbytes = len(request_bytes) if request_bytes else 0
+        self._wire.record(
+            method, received=0 if inproc else nbytes, transport=transport
+        )
+        req = messages.unpack(request_bytes) if request_bytes else None
+        try:
+            resp = fn(req) if req is not None else fn({})
+        except EpochFencedError as e:
+            # fencing rejections are a protocol answer, not a bug:
+            # FAILED_PRECONDITION is non-retryable (policy.RETRYABLE_CODES)
+            # so the client re-resolves instead of re-sending (rpc/fencing.py)
+            logger.warning("RPC %s fenced: %s", method, e)
+            raise PolicyRpcError(
+                grpc.StatusCode.FAILED_PRECONDITION, _sanitized_detail(e)
+            )
+        except Exception as e:
+            logger.exception("RPC handler %s failed", method)
+            # carry a sanitized one-line summary so the client can tell
+            # a shape mismatch from an uninitialized shard without
+            # reading server logs
+            raise PolicyRpcError(grpc.StatusCode.INTERNAL, _sanitized_detail(e))
+        resp_bytes = messages.pack(resp)
+        self._wire.record(
+            method,
+            sent=0 if inproc else len(resp_bytes),
+            transport=transport,
+            calls=1,
+        )
+        return resp_bytes
+
+
+# --------------------------------------------------------------------------
+# inproc: same-interpreter dispatch registry, keyed by the gRPC port
+
+
+_inproc_lock = threading.Lock()
+_inproc_registry: Dict[int, ServerDispatcher] = {}
+
+
+def register_inproc(port: int, dispatcher: ServerDispatcher) -> None:
+    with _inproc_lock:
+        _inproc_registry[int(port)] = dispatcher
+
+
+def unregister_inproc(port: int) -> None:
+    with _inproc_lock:
+        _inproc_registry.pop(int(port), None)
+
+
+def inproc_dispatcher(port: int) -> Optional[ServerDispatcher]:
+    with _inproc_lock:
+        return _inproc_registry.get(int(port))
+
+
+class InprocTransport:
+    """Direct dispatch into a same-interpreter RpcServer. The packed
+    codec frame crosses by reference — zero wire bytes, zero copies.
+    The dispatcher is re-resolved per call so a shard relaunch (new
+    server object on a new port -> new client) or a stopped server
+    surfaces as UNAVAILABLE for the retry/recovery machinery, never a
+    stale handler table."""
+
+    name = TRANSPORT_INPROC
+
+    def __init__(self, port: int, fault_plan=None):
+        self._port = int(port)
+        self._plan = fault_plan
+
+    def call(self, method: str, payload: bytes, timeout: float) -> bytes:
+        after = transport_faults_before(self._plan, method, "client")
+        dispatcher = inproc_dispatcher(self._port)
+        if dispatcher is None:
+            raise PolicyRpcError(
+                grpc.StatusCode.UNAVAILABLE,
+                f"inproc server for port {self._port} is gone",
+            )
+        resp = dispatcher.dispatch(method, payload, TRANSPORT_INPROC)
+        transport_faults_after(after, method)
+        return resp
+
+
+# --------------------------------------------------------------------------
+# uds: length-prefixed codec frames over AF_UNIX
+
+
+def _recv_exact(conn: socket.socket, n: int, *, eof_ok: bool = False):
+    """Read exactly n bytes; None on a clean EOF at a frame boundary
+    (eof_ok), ConnectionError on EOF mid-frame."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = conn.recv_into(view[got:], n - got)
+        if k == 0:
+            if eof_ok and got == 0:
+                return None
+            raise ConnectionError(f"peer closed mid-frame ({got}/{n} bytes)")
+        got += k
+    return bytes(buf)
+
+
+class UdsServer:
+    """Threaded Unix-domain-socket listener sharing an RpcServer's
+    dispatcher. One thread per connection; each connection carries
+    sequential request/response frames (clients pool connections for
+    concurrency). Raises OSError from __init__ when the socket path is
+    unusable — the caller logs and serves gRPC only."""
+
+    def __init__(self, port: int, dispatcher: ServerDispatcher):
+        self.path = uds_path_for(port)
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.path)
+        self._sock.listen(128)
+        self._dispatcher = dispatcher
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        # live connections, severed on close(): a stopped server must
+        # refuse pooled clients exactly like a stopped gRPC server — a
+        # zombie serve thread answering after stop() would let a fenced
+        # shard keep applying requests
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"uds-accept-{self.path}", daemon=True
+        )
+        self._thread.start()
+
+    def _is_closed(self) -> bool:
+        with self._conns_lock:
+            return self._closed
+
+    def _accept_loop(self):
+        while not self._is_closed():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        with self._conns_lock:
+            if self._closed:
+                conn.close()
+                return
+            self._conns.add(conn)
+        try:
+            while not self._is_closed():
+                header = _recv_exact(conn, _REQ_HEADER.size, eof_ok=True)
+                if header is None:
+                    return
+                mlen, blen = _REQ_HEADER.unpack(header)
+                method = _recv_exact(conn, mlen).decode("utf-8")
+                body = _recv_exact(conn, blen)
+                try:
+                    resp = self._dispatcher.dispatch(method, body, TRANSPORT_UDS)
+                except grpc.RpcError as e:
+                    code = e.code() if callable(getattr(e, "code", None)) else None
+                    if not isinstance(code, grpc.StatusCode):
+                        code = grpc.StatusCode.INTERNAL
+                    details = ""
+                    if callable(getattr(e, "details", None)):
+                        details = e.details() or ""
+                    detail_b = details.encode("utf-8")[:1024]
+                    conn.sendall(
+                        _RESP_ERR.pack(1, code.value[0], len(detail_b)) + detail_b
+                    )
+                    continue
+                conn.sendall(_RESP_OK.pack(0, len(resp)))
+                conn.sendall(resp)
+        except (ConnectionError, OSError):
+            pass  # client went away; per-connection state is none
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        with self._conns_lock:
+            self._closed = True
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class UdsTransport:
+    """Client side of the UDS fast path: a small pool of persistent
+    connections (the worker's pipelined step reports overlap calls), a
+    per-call socket timeout from the remaining deadline budget, and
+    PolicyRpcError surfaces mirroring the gRPC tier: timeouts become
+    DEADLINE_EXCEEDED, connection failures UNAVAILABLE — both retryable
+    — and server error frames rebuild the server's status code."""
+
+    name = TRANSPORT_UDS
+
+    def __init__(self, path: str, fault_plan=None):
+        self._path = path
+        self._plan = fault_plan
+        self._pool: list = []
+        self._pool_lock = threading.Lock()
+
+    def _checkout(self) -> socket.socket:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            conn.connect(self._path)
+        except OSError as e:
+            conn.close()
+            raise PolicyRpcError(
+                grpc.StatusCode.UNAVAILABLE, f"uds connect {self._path}: {e}"
+            )
+        return conn
+
+    def _checkin(self, conn: socket.socket):
+        with self._pool_lock:
+            if len(self._pool) < 8:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def call(self, method: str, payload: bytes, timeout: float) -> bytes:
+        after = transport_faults_before(self._plan, method, "client")
+        conn = self._checkout()
+        try:
+            conn.settimeout(max(0.001, float(timeout)))
+            mb = method.encode("utf-8")
+            conn.sendall(_REQ_HEADER.pack(len(mb), len(payload)) + mb)
+            conn.sendall(payload)
+            status = _recv_exact(conn, 1)[0]
+            if status == 0:
+                (blen,) = struct.unpack("<I", _recv_exact(conn, 4))
+                body = _recv_exact(conn, blen)
+            else:
+                code_val, dlen = struct.unpack("<iH", _recv_exact(conn, 6))
+                detail = _recv_exact(conn, dlen).decode("utf-8", "replace")
+                code = _CODE_BY_VALUE.get(code_val, grpc.StatusCode.UNKNOWN)
+                self._checkin(conn)
+                conn = None
+                raise PolicyRpcError(code, detail)
+        except socket.timeout:
+            conn.close()
+            conn = None
+            raise PolicyRpcError(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"uds call {method} timed out after {timeout:.3f}s",
+            )
+        except (ConnectionError, OSError, struct.error) as e:
+            conn.close()
+            conn = None
+            raise PolicyRpcError(
+                grpc.StatusCode.UNAVAILABLE, f"uds {self._path}: {e}"
+            )
+        finally:
+            if conn is not None:
+                self._checkin(conn)
+        transport_faults_after(after, method)
+        return body
+
+
+# --------------------------------------------------------------------------
+# selection
+
+
+def _endpoint_port(addr: str) -> Optional[int]:
+    host, _, port_s = addr.rpartition(":")
+    try:
+        return int(port_s)
+    except ValueError:
+        return None
+
+
+def endpoint_is_local(addr: str) -> bool:
+    """Co-location detection from the endpoint string the client
+    already holds (GetPSConfig / shard_host hand out localhost:<port>
+    for same-host shards; see master/shard_host.py)."""
+    host = addr.rpartition(":")[0].strip().lower()
+    if host in _LOCAL_HOSTS:
+        return True
+    try:
+        return host == socket.gethostname().lower()
+    except OSError:  # pragma: no cover
+        return False
+
+
+def select_transport(addr: str, fault_plan=None):
+    """The fast-path transport for `addr` under the configured mode, or
+    None for plain gRPC. Never raises: any doubt (remote host, no
+    socket file, unparseable endpoint) means gRPC."""
+    mode = transport_mode()
+    if mode == TRANSPORT_GRPC:
+        return None
+    port = _endpoint_port(addr)
+    if port is None or not endpoint_is_local(addr):
+        return None
+    if mode in (TRANSPORT_INPROC, "auto") and inproc_dispatcher(port) is not None:
+        return InprocTransport(port, fault_plan)
+    if mode in (TRANSPORT_UDS, "auto"):
+        path = uds_path_for(port)
+        if os.path.exists(path):
+            return UdsTransport(path, fault_plan)
+    return None

@@ -42,11 +42,13 @@ from elasticdl_tpu.api.model_spec import ModelSpec
 from elasticdl_tpu.common.constants import (
     ENV_BENCH_MFU,
     ENV_BET_PREFETCH,
+    ENV_SYNC_COMPRESS,
     ENV_SYNC_DEPTH,
     ENV_SYNC_DTYPE,
     MAX_MINIBATCH_RETRY_NUM,
     Mode,
 )
+from elasticdl_tpu.common import codec
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.common.timing import PhaseTimers
 from elasticdl_tpu.common.messages import MethodType, Task, TaskType
@@ -83,6 +85,28 @@ def validate_eval_metrics(raw: dict):
             )
 
 
+def _parse_sync_compress(spec: str) -> float:
+    """"topk:<ratio>" -> the ratio (0 < r <= 1); "" / "none" -> 0.0
+    (off). Anything else is a config error, surfaced at worker
+    construction instead of mid-job."""
+    spec = (spec or "").strip().lower()
+    if not spec or spec == "none":
+        return 0.0
+    if spec.startswith("topk:"):
+        try:
+            ratio = float(spec.split(":", 1)[1])
+        except ValueError:
+            ratio = float("nan")
+        if 0.0 < ratio <= 1.0:
+            return ratio
+        raise ValueError(
+            f"sync_compress topk ratio must be in (0, 1], got {spec!r}"
+        )
+    raise ValueError(
+        f"unsupported sync_compress {spec!r} (expected 'topk:<ratio>')"
+    )
+
+
 class EmbeddingInput(NamedTuple):
     """Device-side view of one embedding table's batch slice."""
 
@@ -106,7 +130,8 @@ class Worker:
         ps_endpoints=None,  # sharded PS (master/ps_shard.py) fan-out
         step_pipeline: int = 0,
         kv_endpoints=None,  # sharded embedding KV (master/kv_group.py)
-        sync_dtype: Optional[str] = None,  # bf16 sync plane w/ EF residual
+        sync_dtype: Optional[str] = None,  # bf16/int8 sync plane w/ EF residual
+        sync_compress: Optional[str] = None,  # "topk:<ratio>" sparsification
     ):
         self._id = worker_id
         self._master = master
@@ -122,23 +147,28 @@ class Worker:
         self._minibatch_size = minibatch_size
         self._mesh = mesh
         self._transport_dtype = transport_dtype
-        # Opt-in lossy sync plane (--sync_dtype bf16 / EDL_SYNC_DTYPE):
-        # window deltas and per-step flat grads ride the wire as
-        # bfloat16, with the quantization error kept locally as an
-        # error-feedback residual that is folded into the NEXT delta
-        # before quantizing — the running sum of what the PS applied
-        # tracks the true f32 trajectory to within one bf16 quantum,
-        # so window math converges instead of accumulating drift.
-        # Default float32 keeps the sync plane bit-exact.
+        # Opt-in lossy sync plane (--sync_dtype bf16|int8 /
+        # EDL_SYNC_DTYPE, --sync_compress topk:<ratio> /
+        # EDL_SYNC_COMPRESS): window deltas and per-step flat grads
+        # ride the wire quantized (bf16 cast or int8 per-chunk scaled)
+        # and/or top-k sparsified, with the compression error kept
+        # locally as an error-feedback residual that is folded into the
+        # NEXT delta before compressing — the running sum of what the
+        # PS applied tracks the true f32 trajectory (telescoping
+        # bound), so window math converges instead of accumulating
+        # drift. Default float32 keeps the sync plane bit-exact. Top-k
+        # applies to window deltas only (per-step grads are already
+        # latency-bound, not size-bound, and sparsifying the optimizer
+        # input changes per-step semantics); int8/bf16 apply to both.
         if sync_dtype is None:
             sync_dtype = os.environ.get(ENV_SYNC_DTYPE, "") or "float32"
         sync_dtype = {"bf16": "bfloat16", "f32": "float32"}.get(
             sync_dtype, sync_dtype
         )
-        if sync_dtype not in ("float32", "bfloat16"):
+        if sync_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
                 f"unsupported sync_dtype {sync_dtype!r} "
-                "(float32|bfloat16|bf16)"
+                "(float32|bfloat16|bf16|int8)"
             )
         if sync_dtype == "bfloat16" and _BF16 is None:  # pragma: no cover
             logger.warning(
@@ -147,14 +177,19 @@ class Worker:
             )
             sync_dtype = "float32"
         self._sync_dtype = sync_dtype
-        if sync_dtype == "bfloat16" and transport_dtype == "bfloat16":
-            # EF quantization needs the FULL-precision delta/grad as its
-            # input (residual = f32 - bf16(f32)); the legacy step-fn
-            # pre-cast would destroy the residual source, so sync_dtype
-            # supersedes it. Model-down still rides bf16 (see
+        if sync_compress is None:
+            sync_compress = os.environ.get(ENV_SYNC_COMPRESS, "") or ""
+        self._topk_ratio = _parse_sync_compress(sync_compress)
+        if self._lossy_sync() and transport_dtype == "bfloat16":
+            # EF compression needs the FULL-precision delta/grad as its
+            # input (residual = f32 - compress(f32)); the legacy step-fn
+            # pre-cast would destroy the residual source, so the lossy
+            # sync plane supersedes it. Model-down still rides bf16 (see
             # _model_wire_dtype), so no wire bytes are lost.
             logger.info(
-                "sync_dtype=bfloat16 supersedes transport_dtype=bfloat16"
+                "lossy sync plane (%s%s) supersedes transport_dtype=bfloat16",
+                self._sync_dtype,
+                f" + topk:{self._topk_ratio}" if self._topk_ratio else "",
             )
             self._transport_dtype = "float32"
         self._ef_residual = None  # device f32 [n], window-delta EF
@@ -477,13 +512,16 @@ class Worker:
         newer model between compute and send, and reporting the newer
         version for an older gradient would corrupt the PS's staleness
         accounting."""
-        if flat and self._sync_dtype == "bfloat16":
-            # quantize ON DEVICE before the d2h round: halves the
+        wire_meta = None
+        if flat and self._sync_dtype in ("bfloat16", "int8"):
+            # quantize ON DEVICE before the d2h round: shrinks the
             # device-link bytes too, and the EF residual stays resident
-            grads = self._ef_quantize_grad(grads)
+            wire_meta, grads = self._ef_quantize_grad(grads)
         grads_h, aux_h, loss_h = jax.device_get(
             (grads, aux_state or None, loss)
         )
+        if wire_meta is not None:
+            grads_h = self._materialize_wire_delta(wire_meta, grads_h)
         if version is None:
             with self._report_lock:
                 version = self._version
@@ -602,48 +640,142 @@ class Worker:
             return g.astype(_BF16)
         return g
 
+    def _lossy_sync(self) -> bool:
+        """Whether the up-direction sync plane is lossy (EF-compressed):
+        bf16/int8 quantization or top-k sparsification."""
+        return self._sync_dtype in ("bfloat16", "int8") or self._topk_ratio > 0
+
     def _model_wire_dtype(self):
         """Dtype requested for model-DOWN payloads (pull / piggyback).
         The down direction carries no residual (the worker immediately
         widens to f32 and trains on), so it is plain quantization —
-        requested whenever EITHER lossy knob is on."""
-        if (
-            self._transport_dtype == "bfloat16"
-            or self._sync_dtype == "bfloat16"
-        ):
-            return "bfloat16"
+        requested whenever ANY lossy knob is on (bf16 transport, or an
+        EF-compressed sync plane: bf16/int8/top-k). int8 model-down is
+        deliberately NOT offered: the model is a running total, not a
+        delta, so per-chunk int8 would quantize the weights themselves."""
+        if self._transport_dtype == "bfloat16" or self._lossy_sync():
+            return "bfloat16" if _BF16 is not None else None
         return None
 
-    # ----------------------------------------- error-feedback quantization
+    # ----------------------------------------- error-feedback compression
     #
-    # sync_dtype=bfloat16: what rides the wire is bf16(x + residual) and
-    # the worker keeps residual' = (x + residual) - f32(bf16(x+residual))
-    # on device. The PS accumulates the quantized stream in f32; its sum
+    # What rides the wire is compress(x + residual) and the worker keeps
+    # residual' = (x + residual) - decompress(compress(x + residual)) on
+    # device. The PS accumulates the decompressed stream in f32; its sum
     # equals the true f32 sum minus the CURRENT residual, so the error
-    # is bounded by one bf16 quantum of the running total instead of
-    # growing with the step count — that is what lets window deltas
-    # converge to the f32 trajectory (tests/test_codec.py EF test).
+    # is bounded by one compression quantum of the running total instead
+    # of growing with the step count — that is what lets window deltas
+    # converge to the f32 trajectory (tests/test_codec.py EF test; the
+    # same bound Karimireddy et al. 2019 prove for arbitrary biased
+    # compressors). Compressors: bf16 cast, int8 per-chunk scaled
+    # quantization, and top-k magnitude sparsification (Deep Gradient
+    # Compression) — top-k composes with bf16/int8 on the kept values.
+    #
+    # Compression runs ON DEVICE (jnp) at compress time; the host-side
+    # codec objects (QuantizedDelta/SparseDelta) are built from the
+    # batched device_get in the sync thread (_materialize_wire_delta),
+    # preserving the link/compute overlap of the chained sync.
+
+    def _int8_quantize_dev(self, comp):
+        """Device int8 per-chunk quantization; same math as
+        codec.quantize_int8 (the host spec it is tested against).
+        Returns (q[n] int8, scale[nchunks] f32, dequantized[n] f32)."""
+        chunk = codec.DEFAULT_INT8_CHUNK
+        n = comp.shape[0]
+        pad = (-n) % chunk
+        padded = jnp.pad(comp, (0, pad)) if pad else comp
+        blocks = padded.reshape(-1, chunk)
+        scale = jnp.abs(blocks).max(axis=1) / 127.0
+        scale = jnp.where(scale > 0, scale, 1.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(blocks / scale[:, None]), -127, 127).astype(
+            jnp.int8
+        )
+        deq = (q.astype(jnp.float32) * scale[:, None]).reshape(-1)[:n]
+        return q.reshape(-1)[:n], scale, deq
+
+    def _ef_compress(self, comp, topk: bool):
+        """Compress `comp` (delta-or-grad + residual, f32 device) per
+        the configured knobs. Returns (meta, dev_arrays, residual):
+        meta is a static descriptor consumed by _materialize_wire_delta
+        after device_get, dev_arrays the device payload, residual the
+        new on-device f32 error mass."""
+        if topk:
+            n = int(comp.shape[0])
+            k = min(n, max(1, int(round(self._topk_ratio * n))))
+            _, idx = jax.lax.top_k(jnp.abs(comp), k)
+            idx = jnp.sort(idx)  # sorted => PS-shard slicing is a range
+            vals = comp[idx]
+            if self._sync_dtype == "int8":
+                q, scale, sent = self._int8_quantize_dev(vals)
+                residual = comp.at[idx].set(vals - sent)
+                return (
+                    ("topk_int8", n, codec.DEFAULT_INT8_CHUNK),
+                    (idx, q, scale),
+                    residual,
+                )
+            if self._sync_dtype == "bfloat16":
+                qv = vals.astype(jnp.bfloat16)
+                sent = qv.astype(jnp.float32)
+                residual = comp.at[idx].set(vals - sent)
+                return ("topk", n, "bfloat16"), (idx, qv), residual
+            # exact values: the only error mass is the dropped tail
+            residual = comp.at[idx].set(0.0)
+            return ("topk", n, "float32"), (idx, vals), residual
+        if self._sync_dtype == "int8":
+            q, scale, deq = self._int8_quantize_dev(comp)
+            return ("int8", codec.DEFAULT_INT8_CHUNK), (q, scale), comp - deq
+        # bfloat16 dense cast (the PR 5 plane)
+        q = comp.astype(jnp.bfloat16)
+        return ("dense",), (q,), comp - q.astype(jnp.float32)
+
+    @staticmethod
+    def _materialize_wire_delta(meta, arrays_h):
+        """Host side of _ef_compress: turn the device_get'd payload
+        arrays into the codec wire object. Called from the sync thread
+        AFTER the batched transfer — no device work here."""
+        kind = meta[0]
+        if kind == "dense":
+            return arrays_h[0]
+        if kind == "int8":
+            q, scale = arrays_h
+            return codec.QuantizedDelta(q=q, scale=scale, chunk=meta[1])
+        if kind == "topk":
+            idx, vals = arrays_h
+            return codec.SparseDelta(indices=idx, values=vals, n=meta[1])
+        if kind == "topk_int8":
+            idx, q, scale = arrays_h
+            return codec.SparseDelta(
+                indices=idx,
+                values=codec.QuantizedDelta(q=q, scale=scale, chunk=meta[2]),
+                n=meta[1],
+            )
+        raise ValueError(f"unknown wire-delta meta {meta!r}")
 
     def _ef_quantize_delta(self, delta_dev):
         """Window-delta EF (called at sync SPAWN on the main thread —
         spawns are sequential, so the residual handoff needs no lock).
         The residual is folded into the next window even when windows
         overlap in flight: each spawn consumes the residual left by the
-        previous spawn, preserving the telescoping sum."""
+        previous spawn, preserving the telescoping sum. Returns
+        (meta, dev_arrays) for _materialize_wire_delta."""
         if self._ef_residual is None or (
             self._ef_residual.shape != delta_dev.shape
         ):
             self._ef_residual = jnp.zeros_like(delta_dev)
         comp = delta_dev + self._ef_residual
-        q = comp.astype(jnp.bfloat16)
-        self._ef_residual = comp - q.astype(jnp.float32)
-        return q
+        meta, arrays, residual = self._ef_compress(
+            comp, topk=self._topk_ratio > 0
+        )
+        self._ef_residual = residual
+        return meta, arrays
 
     def _ef_quantize_grad(self, grad_dev):
-        """Per-step flat-gradient EF. Pipelined reports quantize from
-        worker threads concurrently — the residual read-modify-write
-        must be atomic or two steps would consume the same residual
-        (losing one step's error mass permanently)."""
+        """Per-step flat-gradient EF (bf16/int8 only — top-k is a
+        window-delta knob, see __init__). Pipelined reports quantize
+        from worker threads concurrently — the residual
+        read-modify-write must be atomic or two steps would consume the
+        same residual (losing one step's error mass permanently).
+        Returns (meta, dev_arrays) for _materialize_wire_delta."""
         with self._ef_lock:
             if self._ef_grad_residual is None or (
                 getattr(self._ef_grad_residual, "shape", None)
@@ -651,9 +783,9 @@ class Worker:
             ):
                 self._ef_grad_residual = jnp.zeros_like(grad_dev)
             comp = grad_dev + self._ef_grad_residual
-            q = comp.astype(jnp.bfloat16)
-            self._ef_grad_residual = comp - q.astype(jnp.float32)
-        return q
+            meta, arrays, residual = self._ef_compress(comp, topk=False)
+            self._ef_grad_residual = residual
+        return meta, arrays
 
     def report_task_result(self, task_id: int, err: str = ""):
         self._master.call(
@@ -1267,13 +1399,14 @@ class Worker:
             self._flush_deferred_reports()
             return
         delta_dev = self._flat - self._base_flat  # own buffer, thread-safe
-        if self._sync_dtype == "bfloat16":
-            # EF quantization at spawn time, still on the main thread:
+        wire_meta = None
+        if self._lossy_sync():
+            # EF compression at spawn time, still on the main thread:
             # chained syncs spawn in dispatch order, so each window
             # consumes the residual its predecessor left — the wire
-            # carries bf16 but the SUM of what the PS applies tracks
-            # the f32 trajectory (see _ef_quantize_delta)
-            delta_dev = self._ef_quantize_delta(delta_dev)
+            # carries bf16/int8/top-k but the SUM of what the PS
+            # applies tracks the f32 trajectory (see _ef_quantize_delta)
+            wire_meta, delta_dev = self._ef_quantize_delta(delta_dev)
         elif self._transport_dtype == "bfloat16" and _BF16 is not None:
             # plain cast on DEVICE: halves the per-window d2h bytes
             delta_dev = delta_dev.astype(jnp.bfloat16)
@@ -1340,6 +1473,10 @@ class Worker:
                     [g for _, g in pending_edl],
                 )
             )
+            if wire_meta is not None:
+                # compressed payload: build the codec wire object from
+                # the host copies (device math already ran at spawn)
+                delta_h = self._materialize_wire_delta(wire_meta, delta_h)
             base_version = spawn_base_version
             req = {
                 "delta_flat": delta_h,
@@ -1561,7 +1698,12 @@ class Worker:
         self._pending_edl = []
         # the residual's error mass belongs to the trajectory being
         # discarded — carrying it into the re-pulled state would inject
-        # a phantom correction into the first post-reset window
+        # a phantom correction into the first post-reset window. These
+        # two variables are the ONLY residual state for EVERY lossy
+        # sync mode (bf16 / int8 / top-k, window deltas and per-step
+        # grads — see _ef_compress), so dropping them here covers all
+        # compressors; a new mode must keep its residual in one of them
+        # or add its drop here (tests/test_codec.py pins this).
         self._ef_residual = None
         with self._ef_lock:
             self._ef_grad_residual = None

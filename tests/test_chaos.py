@@ -443,6 +443,9 @@ def _run_training_job(tmp, tag, monkeypatch, chaos_spec):
                 np.asarray(params["Dense_0"]["kernel"]).ravel()[0]
             ),
             "log_dir": log_dir,
+            # which transport tiers the workers actually reached the
+            # master over (the UDS-tier variant pins this)
+            "server_transports": server.wire_stats().get("transports", {}),
         }
     finally:
         manager.stop_relaunch_and_remove_workers()
@@ -518,6 +521,58 @@ def test_chaos_training_job_exact_accounting(tmp_path, monkeypatch):
     assert _grep_logs(fault_free["log_dir"], "chaos:") == 0
     # and the model still converged (y = 2x + 1 fixture)
     assert abs(under_chaos["kernel"] - 2.0) < 0.6, under_chaos["kernel"]
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+def test_chaos_exact_accounting_over_uds_tier(tmp_path, monkeypatch):
+    """The acceptance run again, but with every localhost RPC routed
+    over the Unix-domain-socket fast path (EDL_TRANSPORT=uds inherits
+    into the spawned workers). Faults inject at the UDS framing layer
+    (transport_faults_before/after) instead of gRPC interceptors, and
+    the accounting bar is the same absolute one: every record exactly
+    once, dedup absorbing the drop-retry, shard versions landing at
+    [16, 16]. Uses real subprocess workers — the crash fault's
+    os._exit must kill a worker, not the test process, so the inproc
+    tier is deliberately NOT exercised here (it has no process
+    boundary and no crash surface)."""
+    from elasticdl_tpu.common.constants import ENV_TRANSPORT, ENV_UDS_DIR
+    from elasticdl_tpu.testing import write_linear_records
+
+    tmp = str(tmp_path)
+    for i in range(2):
+        write_linear_records(
+            os.path.join(tmp, f"shard-{i}.rio"), 64, seed=i, noise=0.05
+        )
+    monkeypatch.setenv(ENV_TRANSPORT, "uds")
+    monkeypatch.setenv(ENV_UDS_DIR, tmp)
+    chaos_spec = {
+        "seed": 11,
+        "faults": [
+            {"kind": "error", "code": "UNAVAILABLE",
+             "methods": ["PSPushGrad"], "roles": ["worker"], "every": 4,
+             "max_fires": 3},
+            {"kind": "drop", "methods": ["PSPushGrad"], "roles": ["worker"],
+             "nth": 3},
+            {"kind": "crash", "methods": ["GetTask"], "roles": ["worker"],
+             "targets": ["0"], "nth": 2, "when": "after",
+             "once_file": os.path.join(tmp, "crash.once")},
+        ],
+    }
+    result = _run_training_job(tmp, "uds-chaos", monkeypatch, chaos_spec)
+    # exact accounting: identical absolute numbers to the fault-free
+    # gRPC baseline in test_chaos_training_job_exact_accounting
+    assert result["completed_records"] == 256
+    assert result["versions"] == [16, 16]
+    assert result["applied"] == 32
+    assert result["duplicates"] >= 1, "no drop-retry was deduped"
+    assert result["relaunches"] >= 1
+    assert abs(result["kernel"] - 2.0) < 0.6, result["kernel"]
+    # the fast path actually carried the job: the master saw worker
+    # calls over uds and none over grpc (no silent fallback)
+    tiers = result["server_transports"]
+    assert tiers.get("uds", {}).get("calls", 0) > 0, tiers
+    assert tiers.get("grpc", {}).get("calls", 0) == 0, tiers
 
 
 @pytest.mark.e2e

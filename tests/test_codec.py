@@ -77,3 +77,140 @@ def test_zero_dim_arrays_round_trip():
     out = codec.loads(codec.dumps({"bias": np.asarray(np.float32(3.5))}))
     assert out["bias"].shape == ()
     assert float(out["bias"]) == 3.5
+
+
+# -- compressed wire deltas (QuantizedDelta / SparseDelta) --------------------
+
+
+def _qd(n=5003, seed=0, chunk=None):
+    rng = np.random.default_rng(seed)
+    v = rng.standard_normal(n).astype(np.float32)
+    kw = {} if chunk is None else {"chunk": chunk}
+    return v, codec.quantize_int8(v, **kw)
+
+
+def test_quantize_int8_error_bound():
+    """Per-chunk scaled int8: the reconstruction error is bounded by
+    half a quantization step of the CHUNK's own scale — the bound the
+    EF residual telescopes away."""
+    v, qd = _qd()
+    deq = qd.dequantize()
+    assert deq.shape == v.shape and deq.dtype == np.float32
+    for c in range(qd.scale.size):
+        lo, hi = c * qd.chunk, min(v.size, (c + 1) * qd.chunk)
+        err = np.abs(deq[lo:hi] - v[lo:hi]).max()
+        assert err <= qd.scale[c] / 2 + 1e-7
+
+
+def test_quantize_int8_zero_chunk_scale():
+    """An all-zero chunk must not divide by zero (scale falls back to
+    1.0) and must reconstruct as exact zeros."""
+    v = np.zeros(4096, dtype=np.float32)
+    v[2048:] = 1.0
+    qd = codec.quantize_int8(v, chunk=2048)
+    assert qd.scale[0] == 1.0
+    np.testing.assert_array_equal(qd.dequantize()[:2048], 0.0)
+
+
+@pytest.mark.parametrize(
+    "s,e", [(0, 5003), (0, 1), (17, 2049), (2048, 4096), (4999, 5003), (7, 7)]
+)
+def test_quantized_delta_slice_matches_dense_oracle(s, e):
+    """slice-then-dequantize == dequantize-then-slice, bit exact — the
+    invariant that lets ShardedPS split a compressed delta per shard
+    without decompressing (chunk boundaries never align with shard
+    boundaries, hence the offset bookkeeping)."""
+    _, qd = _qd()
+    np.testing.assert_array_equal(
+        qd.slice(s, e).dequantize(), qd.dequantize()[s:e]
+    )
+
+
+def test_quantized_delta_nested_slice():
+    """A slice of a slice keeps absolute chunk coordinates straight."""
+    _, qd = _qd()
+    inner = qd.slice(100, 4000).slice(50, 1900)
+    np.testing.assert_array_equal(
+        inner.dequantize(), qd.dequantize()[150:2000]
+    )
+
+
+def test_sparse_delta_dense_and_slice_oracle():
+    rng = np.random.default_rng(5)
+    n = 4001
+    idx = np.sort(rng.choice(n, 200, replace=False)).astype(np.int64)
+    vals = rng.standard_normal(200).astype(np.float32)
+    sd = codec.SparseDelta(indices=idx, values=vals, n=n)
+    dense = sd.dense()
+    assert dense.size == n
+    np.testing.assert_array_equal(dense[idx], vals)
+    for s, e in [(0, n), (10, 3500), (2000, 2001), (5, 5)]:
+        np.testing.assert_array_equal(sd.slice(s, e).dense(), dense[s:e])
+
+
+def test_sparse_delta_with_quantized_values_slices():
+    """topk+int8 composition: SparseDelta carrying a QuantizedDelta
+    payload slices without decompressing either layer."""
+    rng = np.random.default_rng(6)
+    n = 10007
+    idx = np.sort(rng.choice(n, 500, replace=False)).astype(np.int32)
+    sd = codec.SparseDelta(
+        indices=idx,
+        values=codec.quantize_int8(
+            rng.standard_normal(500).astype(np.float32), chunk=128
+        ),
+        n=n,
+    )
+    dense = sd.dense()
+    for s, e in [(0, n), (100, 9000), (5000, 5001)]:
+        np.testing.assert_array_equal(sd.slice(s, e).dense(), dense[s:e])
+
+
+def test_sparse_delta_rejects_float_indices():
+    with pytest.raises((TypeError, ValueError)):
+        codec.SparseDelta(
+            indices=np.array([0.5, 1.5]), values=np.ones(2, np.float32), n=4
+        )
+
+
+@pytest.mark.parametrize("dumps", [codec.dumps, codec.dumps_v1])
+def test_compressed_delta_wire_roundtrip(dumps):
+    """Both codec versions carry QD/SD (including the nested topk+int8
+    form) — mixed-version jobs can drain mid-upgrade."""
+    v, qd = _qd(n=4097, seed=1)
+    rng = np.random.default_rng(2)
+    idx = np.sort(rng.choice(v.size, 100, replace=False)).astype(np.int64)
+    sd = codec.SparseDelta(indices=idx, values=v[idx], n=v.size)
+    sd_q = codec.SparseDelta(
+        indices=idx, values=codec.quantize_int8(v[idx], chunk=64), n=v.size
+    )
+    m = codec.loads(dumps({"qd": qd, "sd": sd, "sd_q": sd_q, "l": [qd]}))
+    np.testing.assert_array_equal(m["qd"].dequantize(), qd.dequantize())
+    np.testing.assert_array_equal(m["sd"].dense(), sd.dense())
+    np.testing.assert_array_equal(m["sd_q"].dense(), sd_q.dense())
+    assert isinstance(m["l"][0], codec.QuantizedDelta)
+
+
+def test_delta_helpers_dispatch():
+    v, qd = _qd(n=1025, seed=3)
+    assert codec.delta_length(qd) == 1025
+    assert codec.delta_length(v) == 1025
+    np.testing.assert_array_equal(codec.delta_to_f32(qd), qd.dequantize())
+    np.testing.assert_array_equal(codec.delta_to_f32(v), v)
+    np.testing.assert_array_equal(
+        codec.slice_delta(v, 3, 9), v[3:9]
+    )
+    np.testing.assert_array_equal(
+        codec.slice_delta(qd, 3, 9).dequantize(), qd.dequantize()[3:9]
+    )
+    with pytest.raises(ValueError):
+        codec.delta_to_f32(qd, n=9)
+
+
+def test_int8_wire_bytes_are_quarter_of_f32():
+    """The point of the exercise: the dense int8 frame is ~4x smaller
+    than the f32 frame (int8 payload + f32 scale per 2048-chunk)."""
+    v, qd = _qd(n=1 << 16, seed=4)
+    f32_bytes = len(codec.dumps({"d": v}))
+    int8_bytes = len(codec.dumps({"d": qd}))
+    assert int8_bytes < f32_bytes / 3.5, (f32_bytes, int8_bytes)

@@ -237,6 +237,118 @@ def test_frame_descriptor_lints_the_real_codec():
     ], findings
 
 
+# -- rpc-conformance: transport tier registry --------------------------------
+
+TRANSPORT_GOOD = """
+TRANSPORT_UDS = "uds"
+TRANSPORT_TIERS = ("grpc", TRANSPORT_UDS, "inproc")
+
+
+def transport_faults_before(plan, method, side):
+    return []
+
+
+def transport_faults_after(after, method):
+    pass
+
+
+class ServerDispatcher:
+    def dispatch(self, method, request_bytes, transport):
+        after = transport_faults_before(None, method, "server")
+        resp = b""
+        transport_faults_after(after, method)
+        return resp
+
+
+class UdsTransport:
+    name = TRANSPORT_UDS
+
+    def call(self, method, payload, timeout):
+        after = transport_faults_before(None, method, "client")
+        transport_faults_after(after, method)
+        return b""
+
+
+class UdsServer:
+    def serve(self, dispatcher, method, body):
+        return dispatcher.dispatch(method, body, "uds")
+"""
+
+
+def test_transport_registry_clean(tmp_path):
+    root = _tree(tmp_path, {"transport.py": TRANSPORT_GOOD})
+    assert run_analysis(root, rules=["rpc-conformance"]) == []
+
+
+def test_transport_surface_drift(tmp_path):
+    # one tier renames an argument; another registers an unknown tier
+    src = TRANSPORT_GOOD.replace(
+        "def call(self, method, payload, timeout):",
+        "def call(self, method, body, timeout):",
+    ).replace('name = TRANSPORT_UDS', 'name = "carrier-pigeon"')
+    root = _tree(tmp_path, {"transport.py": src})
+    findings = run_analysis(root, rules=["rpc-conformance"])
+    drift = [f for f in findings if f.check == "transport-surface-drift"]
+    assert len(drift) == 2, findings
+
+
+def test_transport_missing_call_is_surface_drift(tmp_path):
+    src = TRANSPORT_GOOD.replace(
+        "    def call(self, method, payload, timeout):\n"
+        "        after = transport_faults_before(None, method, \"client\")\n"
+        "        transport_faults_after(after, method)\n"
+        "        return b\"\"\n",
+        "    pass\n",
+    )
+    root = _tree(tmp_path, {"transport.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance"
+    )
+    assert "transport-surface-drift" in checks
+
+
+def test_transport_chaos_bypass_client_and_server(tmp_path):
+    # the client tier forgets the before-hook, the dispatcher the after
+    src = TRANSPORT_GOOD.replace(
+        'after = transport_faults_before(None, method, "client")\n'
+        "        transport_faults_after(after, method)",
+        "pass",
+    ).replace(
+        'after = transport_faults_before(None, method, "server")',
+        "after = []",
+    )
+    root = _tree(tmp_path, {"transport.py": src})
+    findings = run_analysis(root, rules=["rpc-conformance"])
+    bypass = [f for f in findings if f.check == "transport-chaos-bypass"]
+    assert len(bypass) == 2, findings
+
+
+def test_transport_dispatch_bypass(tmp_path):
+    # a listener serving its own method table instead of the dispatcher
+    src = TRANSPORT_GOOD.replace(
+        'return dispatcher.dispatch(method, body, "uds")',
+        "return self.handlers[method](body)",
+    )
+    root = _tree(tmp_path, {"transport.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance"
+    )
+    assert "transport-dispatch-bypass" in checks
+
+
+def test_transport_lints_the_real_tree():
+    """The shipped tier registry must satisfy its own contract: same
+    call surface per tier, chaos hooks on every path, every listener
+    funneled through ServerDispatcher."""
+    import elasticdl_tpu
+
+    root = os.path.dirname(elasticdl_tpu.__file__)
+    findings = run_analysis(root, rules=["rpc-conformance"])
+    assert not [
+        f for f in findings if f.check.startswith("transport-")
+    ], findings
+
+
 # -- lock-discipline ---------------------------------------------------------
 
 LOCK_BAD = """

@@ -1,0 +1,466 @@
+"""Transport-tier tests: the inproc/UDS fast paths under the gRPC call
+surface (rpc/transport.py).
+
+Covers tier selection (conservative fallback to gRPC on any doubt),
+round-trips over every tier with the SAME failure semantics (fencing
+-> FAILED_PRECONDITION, handler bugs -> INTERNAL with sanitized
+detail, unknown method -> UNIMPLEMENTED), chaos FaultPlan injection on
+the fast paths, and the WireStats transport dimension: per-endpoint
+bytes summing correctly across mixed tiers, inproc calls counted with
+ZERO wire bytes.
+"""
+
+import os
+import socket
+
+import grpc
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.constants import ENV_TRANSPORT, ENV_UDS_DIR
+from elasticdl_tpu.rpc import transport
+from elasticdl_tpu.rpc.chaos import FaultPlan, InjectedRpcError
+from elasticdl_tpu.rpc.client import RpcClient
+from elasticdl_tpu.rpc.fencing import EpochFencedError, is_fenced_error
+from elasticdl_tpu.rpc.policy import (
+    PolicyRpcError,
+    RetryPolicy,
+    WireStats,
+    aggregate_wire_snapshots,
+)
+from elasticdl_tpu.rpc.server import RpcServer
+
+
+def fast_policy(**kw):
+    kw.setdefault("initial_backoff", 0.01)
+    kw.setdefault("max_backoff", 0.05)
+    return RetryPolicy(**kw)
+
+
+def _echo_handlers(hits=None):
+    def echo(req):
+        if hits is not None:
+            hits.append(req.get("x"))
+        return {"x": req.get("x"), "arr": np.arange(4, dtype=np.float32)}
+
+    def boom(req):
+        raise ValueError("kaboom\nwith newline")
+
+    def fenced(req):
+        raise EpochFencedError("ps", 0, 3, int(req.get("epoch", -1)))
+
+    return {"Echo": echo, "Boom": boom, "Fenced": fenced}
+
+
+@pytest.fixture
+def uds_env(monkeypatch, tmp_path):
+    monkeypatch.setenv(ENV_TRANSPORT, "uds")
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+
+
+@pytest.fixture
+def inproc_env(monkeypatch):
+    monkeypatch.setenv(ENV_TRANSPORT, "inproc")
+
+
+# -- tier selection -----------------------------------------------------------
+
+
+def test_mode_default_and_unknown(monkeypatch):
+    monkeypatch.delenv(ENV_TRANSPORT, raising=False)
+    assert transport.transport_mode() == "grpc"
+    monkeypatch.setenv(ENV_TRANSPORT, "warp-drive")
+    assert transport.transport_mode() == "grpc"
+    monkeypatch.setenv(ENV_TRANSPORT, "AUTO")
+    assert transport.transport_mode() == "auto"
+
+
+def test_select_grpc_mode_returns_none(monkeypatch):
+    monkeypatch.delenv(ENV_TRANSPORT, raising=False)
+    assert transport.select_transport("localhost:12345") is None
+
+
+def test_select_remote_host_falls_back(monkeypatch):
+    monkeypatch.setenv(ENV_TRANSPORT, "auto")
+    assert transport.select_transport("ps-7.example.com:50051") is None
+    assert transport.select_transport("not-an-endpoint") is None
+
+
+def test_select_local_without_counterpart_falls_back(
+    monkeypatch, tmp_path
+):
+    """Local host but no registered dispatcher and no socket file:
+    conservative fallback to gRPC, never a broken fast path."""
+    monkeypatch.setenv(ENV_TRANSPORT, "auto")
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+    assert transport.select_transport("localhost:45999") is None
+
+
+def test_select_auto_prefers_inproc_over_uds(monkeypatch, tmp_path):
+    monkeypatch.setenv(ENV_TRANSPORT, "auto")
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+    disp = transport.ServerDispatcher({}, WireStats("t"))
+    transport.register_inproc(45998, disp)
+    try:
+        # socket file ALSO present; inproc must win (fewer copies)
+        path = transport.uds_path_for(45998)
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.bind(path)
+        try:
+            t = transport.select_transport("localhost:45998")
+            assert t is not None and t.name == "inproc"
+        finally:
+            s.close()
+            os.unlink(path)
+    finally:
+        transport.unregister_inproc(45998)
+
+
+def test_endpoint_is_local_variants():
+    assert transport.endpoint_is_local("localhost:1")
+    assert transport.endpoint_is_local("127.0.0.1:1")
+    assert transport.endpoint_is_local("[::1]:1")
+    assert transport.endpoint_is_local(f"{socket.gethostname()}:1")
+    assert not transport.endpoint_is_local("10.0.0.7:1")
+
+
+def test_uds_dir_env_override(monkeypatch, tmp_path):
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+    assert transport.uds_path_for(77) == str(tmp_path / "edl-uds-77.sock")
+
+
+# -- round-trips over each tier ----------------------------------------------
+
+
+def _roundtrip(client):
+    resp = client.call("Echo", {"x": 41}, timeout=10)
+    assert resp["x"] == 41
+    np.testing.assert_array_equal(
+        resp["arr"], np.arange(4, dtype=np.float32)
+    )
+
+
+@pytest.mark.parametrize("env_fixture", ["uds_env", "inproc_env"])
+def test_fast_tier_roundtrip_and_errors(env_fixture, request):
+    """Echo round-trip plus the three failure classifications, on each
+    fast tier — byte-identical semantics to the gRPC tier."""
+    request.getfixturevalue(env_fixture)
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        expected = ENV_TRANSPORT and os.environ[ENV_TRANSPORT]
+        assert client._transport is not None
+        assert client._transport.name == expected
+        _roundtrip(client)
+        # handler bug -> INTERNAL, sanitized single-line detail
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("Boom", {}, timeout=10)
+        assert ei.value.code() == grpc.StatusCode.INTERNAL
+        assert "ValueError" in ei.value.details()
+        assert "\n" not in ei.value.details()
+        # fencing -> FAILED_PRECONDITION, client-side classifier agrees
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("Fenced", {"epoch": 9}, timeout=10)
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert is_fenced_error(ei.value)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_uds_unknown_method_unimplemented(uds_env):
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("NoSuch", {}, timeout=5)
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_inproc_server_gone_is_unavailable(inproc_env):
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        _roundtrip(client)
+        server.stop()  # unregisters the dispatcher
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("Echo", {"x": 1}, timeout=1)
+        assert ei.value.code() in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_uds_server_gone_is_unavailable(uds_env):
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        _roundtrip(client)
+        server.stop()
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("Echo", {"x": 1}, timeout=1)
+        assert ei.value.code() in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_uds_concurrent_calls(uds_env):
+    """The worker's pipelined reports overlap calls on one client; the
+    connection pool must keep request/response frames paired."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futs = [
+                pool.submit(client.call, "Echo", {"x": i}, 30)
+                for i in range(32)
+            ]
+            got = sorted(f.result()["x"] for f in futs)
+        assert got == list(range(32))
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_uds_large_payload_roundtrip(uds_env):
+    """A multi-megabyte codec frame (a real model delta) crosses the
+    socket intact — exercises the chunked recv_into path."""
+    vec = np.random.default_rng(3).standard_normal(1 << 19).astype(np.float32)
+
+    def big(req):
+        np.testing.assert_array_equal(req["v"], vec)
+        return {"v": req["v"] * 2}
+
+    server = RpcServer({"Big": big}, port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        assert client._transport is not None
+        resp = client.call("Big", {"v": vec}, timeout=30)
+        np.testing.assert_allclose(resp["v"], vec * 2)
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- chaos injection on the fast paths ---------------------------------------
+
+
+def test_uds_client_error_injection_retried(uds_env):
+    hits = []
+    server = RpcServer(_echo_handlers(hits), port=0)
+    server.start()
+    plan = FaultPlan.from_spec(
+        {"faults": [{"kind": "error", "methods": ["Echo"], "nth": 1}]}
+    )
+    client = RpcClient(
+        f"localhost:{server.port}", policy=fast_policy(), fault_plan=plan
+    )
+    try:
+        assert client._transport is not None and client._transport.name == "uds"
+        assert client.call("Echo", {"x": 1}, timeout=10, idempotent=True)[
+            "x"
+        ] == 1
+        assert hits == [1], "injected attempt must never reach the server"
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_uds_drop_applies_then_retry_reaches_server(uds_env):
+    """Same contract as the gRPC interceptor: a dropped response means
+    the handler RAN; the retry hits the server a second time (which is
+    why mutating ops carry report_keys)."""
+    hits = []
+    server = RpcServer(_echo_handlers(hits), port=0)
+    server.start()
+    plan = FaultPlan.from_spec(
+        {"faults": [{"kind": "drop", "methods": ["Echo"], "nth": 1}]}
+    )
+    client = RpcClient(
+        f"localhost:{server.port}", policy=fast_policy(), fault_plan=plan
+    )
+    try:
+        assert client.call("Echo", {"x": 7}, timeout=10, idempotent=True)[
+            "x"
+        ] == 7
+        assert hits == [7, 7]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_inproc_server_side_error_injection(inproc_env):
+    hits = []
+    plan = FaultPlan.from_spec(
+        {
+            "faults": [
+                {"kind": "error", "methods": ["Echo"], "side": "server",
+                 "nth": 1, "code": "UNAVAILABLE"}
+            ]
+        }
+    )
+    server = RpcServer(_echo_handlers(hits), port=0, fault_plan=plan)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        assert client._transport is not None
+        assert client.call("Echo", {"x": 2}, timeout=10, idempotent=True)[
+            "x"
+        ] == 2
+        # server-side injection fires before the handler; retry landed
+        assert hits == [2]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_uds_injected_error_is_policy_error(uds_env):
+    """Non-idempotent calls surface the injected error unretried, as
+    the exact class the policy/chaos stack uses everywhere."""
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    plan = FaultPlan.from_spec(
+        {"faults": [{"kind": "error", "methods": ["Echo"], "nth": 1}]}
+    )
+    client = RpcClient(
+        f"localhost:{server.port}", policy=fast_policy(), fault_plan=plan
+    )
+    try:
+        with pytest.raises(InjectedRpcError):
+            client.call("Echo", {"x": 1}, timeout=10, idempotent=False)
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- WireStats transport dimension -------------------------------------------
+
+
+def test_wire_stats_transport_rows():
+    w = WireStats("t")
+    w.record("M", sent=100, transport="grpc")
+    w.record("M", received=50, transport="grpc")
+    w.record("M", sent=30, received=7, transport="uds")
+    w.record("M", sent=0, received=0, transport="inproc", calls=1)
+    snap = w.snapshot()
+    assert snap["bytes_sent"] == 130
+    assert snap["bytes_received"] == 57
+    t = snap["transports"]
+    assert t["grpc"] == {"bytes_sent": 100, "bytes_received": 50, "calls": 1}
+    assert t["uds"] == {"bytes_sent": 30, "bytes_received": 7, "calls": 1}
+    # the inproc row proves the call HAPPENED with zero wire bytes
+    assert t["inproc"] == {"bytes_sent": 0, "bytes_received": 0, "calls": 1}
+    w.reset()
+    assert w.snapshot()["transports"] == {}
+
+
+def test_wire_stats_aggregate_mixed_tiers():
+    """Per-endpoint snapshots from a mixed fan-out (some shards over
+    gRPC, one co-located over UDS, one inproc) roll up per tier AND in
+    total — the bytes-per-sync bench splits on exactly this."""
+    a, b, c = WireStats("a"), WireStats("b"), WireStats("c")
+    a.record("Push", sent=400, received=20, transport="grpc")
+    b.record("Push", sent=100, received=5, transport="uds")
+    c.record("Push", sent=0, received=0, transport="inproc", calls=1)
+    agg = aggregate_wire_snapshots(
+        [a.snapshot(), b.snapshot(), c.snapshot()]
+    )
+    assert agg["bytes_sent"] == 500
+    assert agg["bytes_received"] == 25
+    assert agg["methods"]["Push"]["calls"] == 3
+    t = agg["transports"]
+    assert t["grpc"]["bytes_sent"] == 400
+    assert t["uds"]["bytes_sent"] == 100
+    assert t["inproc"] == {"bytes_sent": 0, "bytes_received": 0, "calls": 1}
+
+
+def test_wire_stats_aggregate_tolerates_legacy_snapshots():
+    """Snapshots from an older process (no "transports" key) still
+    aggregate — rolling upgrades must not crash the rollup."""
+    w = WireStats("new")
+    w.record("M", sent=10, transport="uds")
+    legacy = {
+        "bytes_sent": 5,
+        "bytes_received": 1,
+        "methods": {"M": {"bytes_sent": 5, "bytes_received": 1, "calls": 1}},
+    }
+    agg = aggregate_wire_snapshots([legacy, w.snapshot()])
+    assert agg["bytes_sent"] == 15
+    assert agg["transports"]["uds"]["bytes_sent"] == 10
+
+
+def test_endpoint_accounting_over_uds_matches_grpc(uds_env, monkeypatch):
+    """The client's per-endpoint WireStats must tally UDS payload bytes
+    exactly like gRPC would (same codec frames, tier label aside), and
+    the server's side must mirror them."""
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        client.wire.reset()
+        _roundtrip(client)
+        snap = client.wire.snapshot()
+        assert list(snap["transports"]) == ["uds"]
+        row = snap["transports"]["uds"]
+        assert row["bytes_sent"] > 0 and row["bytes_received"] > 0
+        srv = server.wire.snapshot()["transports"]["uds"]
+        # client sent == server received, and vice versa
+        assert srv["bytes_received"] == row["bytes_sent"]
+        assert srv["bytes_sent"] == row["bytes_received"]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_inproc_calls_report_zero_wire_bytes(inproc_env):
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        client.wire.reset()
+        for i in range(3):
+            client.call("Echo", {"x": i}, timeout=10)
+        snap = client.wire.snapshot()
+        assert snap["bytes_sent"] == 0 and snap["bytes_received"] == 0
+        assert snap["transports"]["inproc"]["calls"] == 3
+        assert snap["methods"]["Echo"]["calls"] == 3
+        srv = server.wire.snapshot()["transports"]["inproc"]
+        assert srv == {"bytes_sent": 0, "bytes_received": 0, "calls": 3}
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- dispatcher conformance ---------------------------------------------------
+
+
+def test_dispatcher_methods_match_handler_table():
+    h = _echo_handlers()
+    disp = transport.ServerDispatcher(h, WireStats("t"))
+    assert disp.methods() == frozenset(h)
+
+
+def test_uds_path_rendezvous_is_port_keyed(monkeypatch, tmp_path):
+    """Parent and shard subprocesses agree on the socket path from the
+    endpoint port alone (master/shard_host.py pins ENV_UDS_DIR)."""
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+    assert transport.uds_path_for(50051) == transport.uds_path_for(50051)
+    assert transport.uds_path_for(50051) != transport.uds_path_for(50052)

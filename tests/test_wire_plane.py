@@ -302,7 +302,9 @@ def test_ef_residual_telescopes_the_quantization_error():
     for _ in range(50):
         d = rng.standard_normal(257).astype(np.float32) * 1e-3
         true_sum += d
-        q = w._ef_quantize_delta(jnp.asarray(d))
+        meta, arrs = w._ef_quantize_delta(jnp.asarray(d))
+        assert meta == ("dense",)
+        q = arrs[0]
         assert q.dtype == jnp.bfloat16
         wire_sum += np.asarray(q).astype(np.float32)
     residual = np.asarray(w._ef_residual)
@@ -322,7 +324,7 @@ def test_ef_beats_plain_quantization_on_accumulated_drift():
     plain_sum = np.zeros(512, dtype=np.float32)
     for d in deltas:
         ef_sum += np.asarray(
-            w._ef_quantize_delta(jnp.asarray(d))
+            w._ef_quantize_delta(jnp.asarray(d))[1][0]
         ).astype(np.float32)
         plain_sum += np.asarray(
             jnp.asarray(d).astype(jnp.bfloat16)
@@ -344,7 +346,7 @@ def test_ef_grad_quantizer_is_thread_safe():
     out = [None] * len(grads)
 
     def quantize(i):
-        out[i] = np.asarray(w._ef_quantize_grad(jnp.asarray(grads[i])))
+        out[i] = np.asarray(w._ef_quantize_grad(jnp.asarray(grads[i]))[1][0])
 
     threads = [
         threading.Thread(target=quantize, args=(i,))
@@ -356,6 +358,128 @@ def test_ef_grad_quantizer_is_thread_safe():
     true_sum = np.sum(grads, axis=0)
     residual = np.asarray(w._ef_grad_residual)
     np.testing.assert_allclose(wire_sum + residual, true_sum, atol=1e-5)
+
+
+def test_int8_ef_residual_telescopes():
+    """Same telescoping identity as bf16, on the int8 per-chunk path:
+    sum(dequantized wire deltas) + residual == sum(true deltas)."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.common import codec
+
+    w = _dummy_worker(sync_dtype="int8")
+    rng = np.random.default_rng(13)
+    true_sum = np.zeros(300, dtype=np.float32)
+    wire_sum = np.zeros(300, dtype=np.float32)
+    for _ in range(30):
+        d = rng.standard_normal(300).astype(np.float32) * 1e-3
+        true_sum += d
+        meta, arrs = w._ef_quantize_delta(jnp.asarray(d))
+        assert meta == ("int8", codec.DEFAULT_INT8_CHUNK)
+        delta = w._materialize_wire_delta(
+            meta, [np.asarray(a) for a in arrs]
+        )
+        assert isinstance(delta, codec.QuantizedDelta)
+        assert delta.q.dtype == np.int8
+        wire_sum += delta.dequantize()
+    residual = np.asarray(w._ef_residual)
+    np.testing.assert_allclose(wire_sum + residual, true_sum, atol=1e-5)
+
+
+def test_topk_ef_residual_telescopes():
+    """Top-k sparsification with EF: the unsent coordinates ride the
+    residual, so the cumulative wire stream still tracks the true
+    trajectory exactly (Deep Gradient Compression's memory term)."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.common import codec
+
+    w = _dummy_worker(sync_compress="topk:0.1")
+    assert w._lossy_sync()
+    rng = np.random.default_rng(17)
+    n = 500
+    true_sum = np.zeros(n, dtype=np.float32)
+    wire_sum = np.zeros(n, dtype=np.float32)
+    for _ in range(40):
+        d = rng.standard_normal(n).astype(np.float32) * 1e-3
+        true_sum += d
+        meta, arrs = w._ef_quantize_delta(jnp.asarray(d))
+        assert meta[0] == "topk" and meta[1] == n
+        delta = w._materialize_wire_delta(
+            meta, [np.asarray(a) for a in arrs]
+        )
+        assert isinstance(delta, codec.SparseDelta)
+        assert delta.indices.size == 50  # k = 0.1 * 500
+        wire_sum += delta.dense()
+    residual = np.asarray(w._ef_residual)
+    np.testing.assert_allclose(wire_sum + residual, true_sum, atol=1e-5)
+
+
+def test_topk_int8_composition_telescopes():
+    """topk + int8 stacked: BOTH the dropped coordinates and the
+    survivors' quantization error land in one residual."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.common import codec
+
+    w = _dummy_worker(sync_dtype="int8", sync_compress="topk:0.2")
+    rng = np.random.default_rng(19)
+    n = 400
+    true_sum = np.zeros(n, dtype=np.float32)
+    wire_sum = np.zeros(n, dtype=np.float32)
+    for _ in range(30):
+        d = rng.standard_normal(n).astype(np.float32) * 1e-3
+        true_sum += d
+        meta, arrs = w._ef_quantize_delta(jnp.asarray(d))
+        assert meta[0] == "topk_int8" and meta[1] == n
+        delta = w._materialize_wire_delta(
+            meta, [np.asarray(a) for a in arrs]
+        )
+        assert isinstance(delta, codec.SparseDelta)
+        assert isinstance(delta.values, codec.QuantizedDelta)
+        wire_sum += delta.dense()
+    residual = np.asarray(w._ef_residual)
+    np.testing.assert_allclose(wire_sum + residual, true_sum, atol=1e-5)
+
+
+def test_parse_sync_compress_validation():
+    from elasticdl_tpu.worker.worker import _parse_sync_compress
+
+    assert _parse_sync_compress(None) == 0.0
+    assert _parse_sync_compress("") == 0.0
+    assert _parse_sync_compress("none") == 0.0
+    assert _parse_sync_compress("topk:0.05") == 0.05
+    assert _parse_sync_compress("topk:1") == 1.0
+    for bad in ("topk:0", "topk:1.5", "topk:", "gzip", "topk:-0.1"):
+        with pytest.raises(ValueError, match="sync_compress"):
+            _parse_sync_compress(bad)
+
+
+def test_sync_compress_env_fallback(monkeypatch):
+    from elasticdl_tpu.common.constants import ENV_SYNC_COMPRESS
+
+    monkeypatch.setenv(ENV_SYNC_COMPRESS, "topk:0.25")
+    w = _dummy_worker()
+    assert w._topk_ratio == 0.25
+    assert w._lossy_sync()
+
+
+def test_topk_wire_bytes_cut_vs_f32():
+    """The acceptance ratio at codec level: topk:0.05 + int8 shrinks a
+    window-delta frame >= 4x vs the f32 frame at model scale."""
+    from elasticdl_tpu.common import codec
+
+    n = 1 << 16
+    rng = np.random.default_rng(23)
+    v = rng.standard_normal(n).astype(np.float32)
+    k = round(0.05 * n)
+    idx = np.sort(np.argsort(np.abs(v))[-k:]).astype(np.int32)
+    sd = codec.SparseDelta(
+        indices=idx, values=codec.quantize_int8(v[idx]), n=n
+    )
+    f32_bytes = len(codec.dumps({"delta_flat": v}))
+    topk_bytes = len(codec.dumps({"delta_flat": sd}))
+    assert topk_bytes * 4 <= f32_bytes, (f32_bytes, topk_bytes)
 
 
 def test_sync_dtype_supersedes_transport_dtype():
@@ -379,13 +503,30 @@ def test_sync_dtype_env_fallback_and_validation(monkeypatch):
         _dummy_worker(sync_dtype="float16")
 
 
-def test_reset_local_state_drops_residuals():
+@pytest.mark.parametrize(
+    "sync_dtype,sync_compress",
+    [
+        ("bf16", None),
+        ("int8", None),
+        (None, "topk:0.5"),
+        ("int8", "topk:0.5"),
+    ],
+)
+def test_reset_local_state_drops_residuals(sync_dtype, sync_compress):
+    """A sync-chain break invalidates the EF residual for EVERY lossy
+    mode — a stale residual re-applied against a restored model would
+    inject error mass that was already (or never) shipped."""
     import jax.numpy as jnp
 
-    w = _dummy_worker(sync_dtype="bf16")
+    w = _dummy_worker(sync_dtype=sync_dtype, sync_compress=sync_compress)
+    assert w._lossy_sync()
     w._ef_quantize_delta(jnp.ones(8, dtype=jnp.float32) * 1e-3)
-    w._ef_quantize_grad(jnp.ones(8, dtype=jnp.float32) * 1e-3)
-    assert w._ef_residual is not None and w._ef_grad_residual is not None
+    assert w._ef_residual is not None
+    if w._sync_dtype in ("bfloat16", "int8"):
+        # the per-step grad path only quantizes for dtype modes
+        # (top-k is a window-delta knob)
+        w._ef_quantize_grad(jnp.ones(8, dtype=jnp.float32) * 1e-3)
+        assert w._ef_grad_residual is not None
     w._reset_local_state()
     assert w._ef_residual is None and w._ef_grad_residual is None
 
@@ -393,7 +534,7 @@ def test_reset_local_state_drops_residuals():
 # -- end-to-end: bf16 EF window sync converges like f32 ----------------------
 
 
-def _run_window_job(tmp_path, tag, sync_dtype):
+def _run_window_job(tmp_path, tag, sync_dtype, sync_compress=None):
     import random
 
     from elasticdl_tpu.api.model_spec_helpers import spec_from_module
@@ -421,6 +562,7 @@ def _run_window_job(tmp_path, tag, sync_dtype):
         minibatch_size=16,
         local_updates=4,
         sync_dtype=sync_dtype,
+        sync_compress=sync_compress,
     )
     worker.run()
     assert dispatcher.finished()
@@ -441,6 +583,26 @@ def test_bf16_ef_window_sync_converges_to_f32_trajectory(tmp_path):
     # trajectory within a bf16-quantum-scale band of the exact one
     np.testing.assert_allclose(k_bf16, k_f32, rtol=2e-2, atol=2e-2)
     assert abs(float(k_bf16.ravel()[0]) - 2.0) < 0.3
+
+
+def test_compressed_window_sync_converges_to_f32_trajectory(tmp_path):
+    """Same bar for the PR 6 compressed modes: int8 window deltas and
+    the stacked int8+topk pipeline run the identical job through the
+    codec wire format (InProcessMaster packs/unpacks both directions,
+    so QuantizedDelta/SparseDelta frames are decoded by the servicer
+    exactly as they would be off the wire) and land near the f32 run."""
+    k_f32, v_f32 = _run_window_job(tmp_path, "f32", None)
+    k_int8, v_int8 = _run_window_job(tmp_path, "int8", "int8")
+    assert v_f32 == v_int8
+    np.testing.assert_allclose(k_int8, k_f32, rtol=2e-2, atol=2e-2)
+    assert abs(float(k_int8.ravel()[0]) - 2.0) < 0.3
+    # topk on the 2-param linear fixture: k=1 of 2 per window — the EF
+    # residual carries the dropped coordinate to the next window, so
+    # convergence survives even maximal sparsification (looser band:
+    # each window ships half the coordinates)
+    k_topk, v_topk = _run_window_job(tmp_path, "topk", "int8", "topk:0.5")
+    assert v_f32 == v_topk
+    assert abs(float(k_topk.ravel()[0]) - 2.0) < 0.4
 
 
 # -- wire-byte accounting ----------------------------------------------------
