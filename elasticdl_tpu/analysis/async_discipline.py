@@ -1,0 +1,195 @@
+"""async-discipline: the dispatch loop never blocks or leaks state.
+
+Interprocedural (edl-verify).
+
+`EDL_DISPATCH=loop` (rpc/dispatch.py) hinges on two conventions the
+runtime cannot cheaply enforce:
+
+1. Coroutines scheduled on the LoopCore must never execute a blocking
+   call — one `time.sleep` (a chaos latency fault), one sync RPC, one
+   unbounded `.acquire()` stalls EVERY connection the loop serves, and
+   only shows up as tail latency under fan-in load. Blocking work is
+   bridged through the bounded executor, and a function REFERENCE
+   passed to `run_in_executor` is not a call edge, so the call graph's
+   reachable-from-coroutine set is exactly the code that runs ON the
+   loop. Awaited calls inside a coroutine are exempt: `await x.wait()`
+   is an async API yielding to the loop, not a thread parking on it.
+
+2. State a class declares loop-confined (`LOOP_ONLY_ATTRS`, e.g.
+   `AsyncUdsServer._writers`) must not be touched from sync methods —
+   those run on executor or caller threads, racing the loop without a
+   lock (the confinement IS the synchronization). `__init__` is exempt:
+   construction completes before the loop ever sees the object.
+
+Checks:
+
+- ``blocking-on-loop``     a blocking operation (time.sleep,
+                           wait-shaped calls, string-method ``.call``,
+                           unbounded ``.acquire()``) lexically in a
+                           coroutine (not awaited) or in any sync
+                           function reachable from one through the
+                           call graph
+- ``loop-state-off-loop``  a sync method (excluding __init__) of a
+                           class declaring LOOP_ONLY_ATTRS reads or
+                           writes one of the declared attributes
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticdl_tpu.analysis.callgraph import CallGraph, FuncKey, blocking_desc
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+
+RULE = "async-discipline"
+
+
+def _acquire_desc(node: ast.Call) -> Optional[str]:
+    """Unbounded lock acquisition: ``x.acquire()`` with no
+    timeout/blocking argument. Bounded forms (`acquire(timeout=...)`,
+    `acquire(False)`) are deliberate and stay quiet."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "acquire"):
+        return None
+    if node.args or node.keywords:
+        return None
+    return ".acquire()"
+
+
+def _coroutine_reachable(g: CallGraph) -> Dict[FuncKey, str]:
+    """{function key: qualname of one coroutine it is reachable from}
+    for every function on a loop-executed path (the coroutines
+    themselves included). Smallest coroutine qualname wins, for
+    deterministic messages."""
+    roots = sorted(
+        (key for key, info in g.functions.items()
+         if isinstance(info.node, ast.AsyncFunctionDef)),
+        key=lambda k: (g.functions[k].qualname, k[0]),
+    )
+    out: Dict[FuncKey, str] = {}
+    for root in roots:
+        via = g.functions[root].qualname
+        stack = [root]
+        while stack:
+            key = stack.pop()
+            if key in out:
+                continue
+            out[key] = via
+            for edge in g.edges.get(key, []):
+                if edge.callee not in out:
+                    stack.append(edge.callee)
+    return out
+
+
+def _own_nodes(func_node: ast.AST) -> Set[ast.AST]:
+    """Nodes belonging to `func_node` itself — nested defs/lambdas are
+    separate graph nodes (and may legitimately run off-loop, e.g. a
+    worker fn handed to the executor), so their bodies are excluded."""
+    nested_roots = [
+        n
+        for n in ast.walk(func_node)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+        and n is not func_node
+    ]
+    nested: Set[ast.AST] = set()
+    for root in nested_roots:
+        nested.update(ast.walk(root))
+    return {n for n in ast.walk(func_node) if n not in nested}
+
+
+def _blocking_sites(
+    func_node: ast.AST, is_coro: bool
+) -> List[Tuple[int, str]]:
+    own = _own_nodes(func_node)
+    awaited: Set[ast.AST] = set()
+    if is_coro:
+        for node in own:
+            if isinstance(node, ast.Await) and isinstance(
+                node.value, ast.Call
+            ):
+                awaited.add(node.value)
+    sites: List[Tuple[int, str]] = []
+    for node in own:
+        if not isinstance(node, ast.Call) or node in awaited:
+            continue
+        desc = blocking_desc(node) or _acquire_desc(node)
+        if desc is not None:
+            sites.append((node.lineno, desc))
+    return sites
+
+
+def _declared_loop_only(cls_node: ast.ClassDef) -> Set[str]:
+    for stmt in cls_node.body:
+        if not (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.targets[0].id == "LOOP_ONLY_ATTRS"
+        ):
+            continue
+        if isinstance(stmt.value, (ast.Tuple, ast.List, ast.Set)):
+            return {
+                e.value
+                for e in stmt.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+    return set()
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    g = CallGraph(ctx)
+    findings: List[Finding] = []
+
+    # -- blocking-on-loop ----------------------------------------------------
+    reachable = _coroutine_reachable(g)
+    for key, via in sorted(
+        reachable.items(), key=lambda kv: (kv[0][0], kv[0][1] or "", kv[0][2])
+    ):
+        func = g.functions[key]
+        is_coro = isinstance(func.node, ast.AsyncFunctionDef)
+        for line, desc in sorted(_blocking_sites(func.node, is_coro)):
+            where = (
+                "coroutine"
+                if is_coro
+                else f"sync function (reachable from coroutine {via})"
+            )
+            findings.append(
+                Finding(
+                    RULE, "blocking-on-loop", func.path, line,
+                    f"{func.qualname} is a {where} and calls {desc} — "
+                    "this runs ON the dispatch loop and stalls every "
+                    "connection it serves; bridge blocking work through "
+                    "the bounded executor",
+                )
+            )
+
+    # -- loop-state-off-loop -------------------------------------------------
+    for (path, cls_name), info in sorted(g.classes.items()):
+        declared = _declared_loop_only(info.node)
+        if not declared:
+            continue
+        for name, fn in sorted(info.methods.items()):
+            if name == "__init__" or isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in sorted(
+                _own_nodes(fn), key=lambda n: getattr(n, "lineno", 0)
+            ):
+                if not (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr in declared
+                ):
+                    continue
+                findings.append(
+                    Finding(
+                        RULE, "loop-state-off-loop", path, node.lineno,
+                        f"{cls_name}.{name} touches self.{node.attr}, "
+                        f"declared loop-confined (LOOP_ONLY_ATTRS) — sync "
+                        "methods run on executor/caller threads and race "
+                        "the loop without a lock; move the access into a "
+                        "coroutine submitted to the LoopCore",
+                    )
+                )
+    return findings

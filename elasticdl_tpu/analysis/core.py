@@ -19,8 +19,9 @@ Rule families (one module each):
 - ``fencing-conformance``  (fencing_conformance.py, interprocedural)
 - ``lock-order``           (lock_order.py, interprocedural)
 - ``abort-discipline``     (abort_discipline.py, interprocedural)
+- ``async-discipline``     (async_discipline.py, interprocedural)
 
-The last three are the edl-verify layer: they run on the repo-wide
+The interprocedural families are the edl-verify layer: they run on the repo-wide
 call graph built by analysis/callgraph.py instead of one file at a
 time, so they can prove cross-file protocol invariants (fencing
 epochs threaded end to end, lock acquisition orders acyclic, handler
@@ -60,6 +61,7 @@ RULE_FAMILIES = (
     "fencing-conformance",
     "lock-order",
     "abort-discipline",
+    "async-discipline",
 )
 
 #: internal families emitted by the core itself (always on, never
@@ -68,7 +70,12 @@ CORE_FAMILIES = ("lint",)
 
 #: the interprocedural (edl-verify) families: baseline entries for
 #: these must carry a written reason (see load_baseline)
-VERIFY_FAMILIES = ("fencing-conformance", "lock-order", "abort-discipline")
+VERIFY_FAMILIES = (
+    "fencing-conformance",
+    "lock-order",
+    "abort-discipline",
+    "async-discipline",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -281,6 +288,7 @@ def _rule_modules():
     # local import: the rule modules import core for Finding
     from elasticdl_tpu.analysis import (
         abort_discipline,
+        async_discipline,
         env_registry,
         fencing_conformance,
         jit_purity,
@@ -297,6 +305,7 @@ def _rule_modules():
         "fencing-conformance": fencing_conformance,
         "lock-order": lock_order,
         "abort-discipline": abort_discipline,
+        "async-discipline": async_discipline,
     }
 
 

@@ -15,6 +15,12 @@ is held — and then flags:
   made while holding a lock — it serializes every other handler behind
   a network/thread wait.
 
+``threading.Condition(self._lock)`` aliases to the wrapped lock
+(acquiring the condition IS acquiring the lock, matching
+callgraph.py); a bare ``Condition()`` guards as its own lock, and
+waiting on a condition you hold is exempt from ``blocking-under-lock``
+— Condition.wait releases the lock while parked.
+
 Helpers designed to run with the caller holding the lock are expected
 to carry a def-line suppression naming the contract, e.g.::
 
@@ -54,6 +60,15 @@ def _is_lock_ctor(node: ast.expr) -> bool:
     return False
 
 
+def _is_cond_ctor(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "Condition") or (
+        isinstance(f, ast.Name) and f.id == "Condition"
+    )
+
+
 def _self_attr(node: ast.expr) -> Optional[str]:
     if (
         isinstance(node, ast.Attribute)
@@ -78,11 +93,24 @@ class _MethodScan(ast.NodeVisitor):
     """One method body: every self-attribute access with the lock set
     held at that point, plus blocking calls made under a lock."""
 
-    def __init__(self, lock_attrs: Set[str]):
+    def __init__(self, lock_attrs: Set[str], aliases: Dict[str, str]):
         self.lock_attrs = lock_attrs
+        #: condition attr -> the lock it wraps (Condition(self._lock)
+        #: aliases to the wrapped lock, matching callgraph.py: acquiring
+        #: the condition IS acquiring the lock)
+        self.aliases = aliases
         self.accesses: List[_Access] = []
         self.blocking: List[Tuple[int, str, str]] = []  # (line, what, lock)
         self._held: List[str] = []
+        #: predicate lambdas of cond.wait_for(...) on a HELD condition:
+        #: wait_for re-acquires the lock before every predicate
+        #: evaluation, so these closures run with the lock held
+        self._cond_predicates: Set[ast.Lambda] = set()
+
+    def _canon(self, attr: Optional[str]) -> Optional[str]:
+        if attr is None:
+            return None
+        return self.aliases.get(attr, attr)
 
     # -- lock tracking
 
@@ -90,8 +118,8 @@ class _MethodScan(ast.NodeVisitor):
         acquired = []
         for item in node.items:
             attr = _self_attr(item.context_expr)
-            if attr in self.lock_attrs:
-                acquired.append(attr)
+            if attr in self.lock_attrs or attr in self.aliases:
+                acquired.append(self._canon(attr))
             else:
                 self.visit(item.context_expr)
         self._held.extend(acquired)
@@ -114,12 +142,15 @@ class _MethodScan(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Lambda(self, node):
+        if node in self._cond_predicates:
+            self.generic_visit(node)  # runs under the re-acquired lock
+            return
         self._enter_closure(node)
 
     # -- accesses
 
     def _record(self, attr: str, line: int, write: bool):
-        if attr in self.lock_attrs:
+        if attr in self.lock_attrs or attr in self.aliases:
             return
         self.accesses.append(
             _Access(attr, line, write, frozenset(self._held))
@@ -160,8 +191,23 @@ class _MethodScan(ast.NodeVisitor):
             attr = _self_attr(node.func.value)
             if attr is not None:
                 self._record(attr, node.lineno, True)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "wait_for"
+            and self._canon(_self_attr(node.func.value)) in self._held
+        ):
+            for arg in node.args:
+                if isinstance(arg, ast.Lambda):
+                    self._cond_predicates.add(arg)
         if self._held:
             what = self._blocking_name(node)
+            if what == ".wait()" and isinstance(node.func, ast.Attribute):
+                # Condition.wait RELEASES the held lock while parked —
+                # waiting on the condition you hold is the protocol,
+                # not a blocking call under a lock
+                cond = self._canon(_self_attr(node.func.value))
+                if cond is not None and cond in self._held:
+                    what = None
             if what is not None:
                 self.blocking.append((node.lineno, what, self._held[-1]))
         self.generic_visit(node)
@@ -198,10 +244,28 @@ def _scan_class(path: str, cls: ast.ClassDef) -> List[Finding]:
                         lock_attrs.add(attr)
     if not lock_attrs:
         return []
+    # second pass: Condition wrappers. Condition(self._lock) aliases to
+    # the wrapped lock; a bare Condition() guards as its own lock.
+    aliases: Dict[str, str] = {}
+    for m in methods:
+        for node in ast.walk(m):
+            if not (isinstance(node, ast.Assign) and _is_cond_ctor(node.value)):
+                continue
+            wrapped = (
+                _self_attr(node.value.args[0]) if node.value.args else None
+            )
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if wrapped is not None and wrapped in lock_attrs:
+                    aliases[attr] = wrapped
+                else:
+                    lock_attrs.add(attr)
 
     scans: Dict[str, _MethodScan] = {}
     for m in methods:
-        scan = _MethodScan(lock_attrs)
+        scan = _MethodScan(lock_attrs, aliases)
         for st in m.body:
             scan.visit(st)
         scans[m.name] = scan

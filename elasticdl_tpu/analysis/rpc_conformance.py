@@ -770,10 +770,12 @@ def _transport_findings(ctx: AnalysisContext) -> List[Finding]:
                 and cls.name.endswith("Server")
                 and cls.name != _DISPATCHER_CLASS
             ):
+                # dispatch_async is the loop-core entry to the same
+                # admission/fault/handler path (rpc/transport.py)
                 routes = any(
                     isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "dispatch"
+                    and node.func.attr in ("dispatch", "dispatch_async")
                     for node in ast.walk(cls)
                 )
                 if not routes:

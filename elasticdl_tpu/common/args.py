@@ -135,6 +135,13 @@ def add_master_args(parser: argparse.ArgumentParser):
         "threads inside the master (tests/single-host)",
     )
     parser.add_argument(
+        "--fanin_combine", action="store_true",
+        help="hierarchical fan-in on the PS shards: compatible "
+        "concurrent pushes are summed outside the shard lock and "
+        "applied as one batch (master/fanin.py; default honors "
+        "EDL_FANIN_COMBINE)",
+    )
+    parser.add_argument(
         "--num_kv_shards", type=non_neg_int, default=0,
         help="N>0: host the embedding tables behind N KV shard "
         "endpoints (workers look rows up directly, bypassing the "

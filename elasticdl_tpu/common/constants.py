@@ -71,6 +71,15 @@ ENV_SYNC_DTYPE = "EDL_SYNC_DTYPE"
 ENV_SYNC_COMPRESS = "EDL_SYNC_COMPRESS"
 ENV_TRANSPORT = "EDL_TRANSPORT"
 ENV_UDS_DIR = "EDL_UDS_DIR"
+ENV_DISPATCH = "EDL_DISPATCH"
+ENV_DISPATCH_EXECUTOR = "EDL_DISPATCH_EXECUTOR"
+ENV_QUEUE_DEPTH_REPORT = "EDL_QUEUE_DEPTH_REPORT"
+ENV_QUEUE_DEPTH_PULL = "EDL_QUEUE_DEPTH_PULL"
+ENV_QUEUE_DEPTH_CONTROL = "EDL_QUEUE_DEPTH_CONTROL"
+ENV_FANIN_COMBINE = "EDL_FANIN_COMBINE"
+ENV_FANIN_BATCH = "EDL_FANIN_BATCH"
+ENV_FANIN_WAIT_MS = "EDL_FANIN_WAIT_MS"
+ENV_BENCH_LINK_FLOOR = "EDL_BENCH_LINK_FLOOR"
 ENV_OPT_MIRROR_SECS = "EDL_OPT_MIRROR_SECS"
 ENV_BET_PREFETCH = "EDL_BET_PREFETCH"
 ENV_BENCH_MFU = "EDL_BENCH_MFU"
@@ -125,6 +134,50 @@ ENV_REGISTRY = {
         "directory for the UDS fast-path sockets (edl-uds-<port>.sock; "
         "default: the system temp dir — must be shared by co-located "
         "processes)"
+    ),
+    ENV_DISPATCH: (
+        "server dispatch core: threads (default; blocking "
+        "thread-per-request) or loop (single asyncio event loop serving "
+        "every tier with bounded-executor handler bridging and "
+        "per-method-class admission queues — rpc/dispatch.py)"
+    ),
+    ENV_DISPATCH_EXECUTOR: (
+        "loop dispatch: bounded executor width for bridged sync "
+        "handlers, per ServerDispatcher (default 32)"
+    ),
+    ENV_QUEUE_DEPTH_REPORT: (
+        "loop dispatch: max in-flight report-class RPCs (push/report "
+        "mutations) before RESOURCE_EXHAUSTED backpressure (default "
+        "1024; retryable under the rpc/policy.py schedule)"
+    ),
+    ENV_QUEUE_DEPTH_PULL: (
+        "loop dispatch: max in-flight pull-class RPCs (model/state "
+        "reads) before RESOURCE_EXHAUSTED backpressure (default 256)"
+    ),
+    ENV_QUEUE_DEPTH_CONTROL: (
+        "loop dispatch: max in-flight control-class RPCs (everything "
+        "else) before RESOURCE_EXHAUSTED backpressure (default 256)"
+    ),
+    ENV_FANIN_COMBINE: (
+        "1 enables the hierarchical window-delta fan-in stage: "
+        "compatible PS-shard pushes are summed OUTSIDE the shard lock "
+        "and applied as one batch (master/fanin.py; default off, also "
+        "--fanin_combine)"
+    ),
+    ENV_FANIN_BATCH: (
+        "fan-in combine: max member pushes per combined batch "
+        "(default 32)"
+    ),
+    ENV_FANIN_WAIT_MS: (
+        "fan-in combine: optional straggler linger in milliseconds — "
+        "a drained batch below EDL_FANIN_BATCH waits this long for "
+        "late arrivals before applying (default 0 = off; the batch "
+        "window is naturally the previous apply's duration)"
+    ),
+    ENV_BENCH_LINK_FLOOR: (
+        "bench.py: probed link-bandwidth floor in MB/s below which a "
+        "window run is marked link_degraded and excluded from best-of "
+        "selection (default 8.0)"
     ),
     ENV_OPT_MIRROR_SECS: (
         "recovery plane: seconds between PS optimizer-state mirror "

@@ -346,7 +346,22 @@ WIRE_SCHEMAS: Dict[str, type] = {
 }
 
 
+class Prepacked:
+    """A response already serialized by the handler. The fan-in combine
+    stage (master/fanin.py) answers every member of a batch with the
+    same merged-model payload; packing it once and handing the SAME
+    bytes to each member's transport turns k response serializations
+    into one. `pack` passes the bytes through untouched."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+
 def pack(obj: Any) -> bytes:
+    if isinstance(obj, Prepacked):
+        return obj.data
     return codec.dumps(obj)
 
 

@@ -199,6 +199,9 @@ def build_master(args, job_type: str, cluster_backend=None):
                 staleness_window=args.staleness_window,
                 k8s_backend=cluster_backend if mode == "k8s" else None,
                 num_workers=args.num_workers,
+                fanin_combine=(
+                    True if getattr(args, "fanin_combine", False) else None
+                ),
             )
             ps_group.start()
 

@@ -60,6 +60,7 @@ class PSShardGroup:
         k8s_backend=None,  # K8sBackend for mode="k8s" (PS pods)
         num_workers: int = 1,
         max_inflight_syncs: int = 8,
+        fanin_combine: Optional[bool] = None,
     ):
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
@@ -82,6 +83,9 @@ class PSShardGroup:
         )
         self._boot_timeout = boot_timeout
         self._dedup_cap = self.dedup_cap_for(num_workers, max_inflight_syncs)
+        # hierarchical fan-in combining (master/fanin.py): None defers
+        # to EDL_FANIN_COMBINE inside each servicer / shard process
+        self._fanin_combine = fanin_combine
         self.endpoints: List[str] = []
         # fencing generation per shard SLOT, bumped on every relaunch;
         # clients stamp these as request epochs (rpc/fencing.py)
@@ -141,6 +145,8 @@ class PSShardGroup:
             flags.append("--use_async")
         if self._sync_flags["lr_staleness_modulation"]:
             flags.append("--lr_staleness_modulation")
+        if self._fanin_combine:
+            flags.append("--fanin_combine")
         return flags
 
     def _start_k8s(self):
@@ -191,6 +197,7 @@ class PSShardGroup:
             optimizer=opt,
             generation=self.generations[i],
             dedup_cap=self._dedup_cap,
+            fanin_combine=self._fanin_combine,
             **self._sync_flags,
         )
         server = RpcServer(servicer.handlers(), port=0)

@@ -51,8 +51,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from elasticdl_tpu.common import codec
+from elasticdl_tpu.common import codec, messages
 from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.master import fanin
 from elasticdl_tpu.master.ps_optimizer import PSOptimizer
 
 logger = get_logger(__name__)
@@ -85,6 +86,7 @@ class PSShardServicer:
         staleness_window: int = 0,
         generation: int = 0,
         dedup_cap: Optional[int] = None,
+        fanin_combine: Optional[bool] = None,
     ):
         self.shard_id = shard_id
         self.num_shards = num_shards
@@ -128,6 +130,25 @@ class PSShardServicer:
         # attached by shard_host/ps_group after server construction so
         # `stats()` answers bytes questions over the existing stats RPC
         self._wire = None
+        # hierarchical fan-in stage (master/fanin.py, --fanin_combine /
+        # EDL_FANIN_COMBINE): compatible concurrent pushes are summed
+        # OUTSIDE self._lock and applied as one batch — one lock
+        # acquisition, one apply, one shared packed response per batch
+        if fanin_combine is None:
+            fanin_combine = fanin.combine_enabled()
+        self._delta_combine = (
+            fanin.CombineBuffer(self._apply_delta_batch)
+            if fanin_combine
+            else None
+        )
+        self._grad_combine = (
+            fanin.CombineBuffer(self._apply_grad_batch)
+            if fanin_combine
+            else None
+        )
+        # combine observability: ratio = combined_reports / batches
+        self._combined_batches = 0
+        self._combined_reports = 0
 
     # -- handler table -------------------------------------------------------
 
@@ -212,97 +233,271 @@ class PSShardServicer:
         (optionally LR-modulated by 1/staleness); sync mode accumulates
         `grads_to_wait` reports within the staleness window. Strict
         equality rejection is refused at configuration time (module
-        docstring) so an accept can never be torn across shards."""
+        docstring) so an accept can never be torn across shards.
+
+        With fan-in combining on, same-lineage concurrent reports
+        rendezvous in the combine buffer and are accumulated as one
+        batch (master/fanin.py)."""
         self._check_epoch(req)
         # no-copy when the wire already carried a dense f32 array: the
         # decoded frombuffer view is applied as-is (it is read-only,
         # and every consumer below uses it only as a ufunc operand).
-        # Compressed wire forms decode here and NOWHERE else: bf16
-        # widens, int8 (QuantizedDelta) dequantizes — shard math is
-        # always full precision
+        # Compressed wire forms decode here — OUTSIDE the lock — and
+        # NOWHERE else: bf16 widens, int8 (QuantizedDelta) dequantizes;
+        # shard math is always full precision
         grad = codec.delta_to_f32(req["grad"])
-        report_version = int(req.get("version", -1))
+        # combine only the pure-accumulate regime (sync, no staleness
+        # scaling): async applies one optimizer step PER report, and
+        # staleness down-weighting depends on each member's version —
+        # neither commutes with presumming. return_model rides the key
+        # so plain reports never share a (fallback) batch with it.
+        if (
+            self._grad_combine is not None
+            and not self._use_async
+            and not self._staleness_window
+        ):
+            key = (
+                "grad",
+                req.get("model_dtype") or "",
+                bool(req.get("return_model")),
+            )
+            return self._grad_combine.submit(key, req, grad)
         with self._lock:
-            if self._vec is None:
-                raise ValueError("gradient pushed before shard init")
-            if self._is_duplicate(req):
-                resp = {"accepted": True, "version": self._version,
-                        "duplicate": True}
-                if req.get("return_model"):
-                    resp["vec"] = self._wire_vec(req)
-                return resp
-            if grad.shape != self._vec.shape:
-                raise ValueError(
-                    f"grad slice shape {grad.shape} != {self._vec.shape}"
-                )
-            staleness = self._version - report_version
-            if self._use_async:
-                scale = 1.0
-                if self._lr_staleness_modulation and staleness > 1:
-                    scale = 1.0 / float(staleness)
-                self._apply(grad * scale if scale != 1.0 else grad)
-            else:
-                # windowed sync: accumulate K reports; staleness beyond
-                # the window is down-weighted (window/staleness) rather
-                # than rejected — rejection cannot be atomic across
-                # shards (module docstring)
-                if self._staleness_window and staleness > self._staleness_window:
-                    grad = grad * (self._staleness_window / float(staleness))
-                if self._grad_sum is None:
-                    self._grad_sum = grad.copy()
-                else:
-                    self._grad_sum += grad
-                self._grad_n += 1
-                if self._grad_n >= self._grads_to_wait:
-                    self._apply(self._grad_sum / self._grad_n)
-                    self._grad_sum = None
-                    self._grad_n = 0
-            self._record_applied(req)
-            resp = {"accepted": True, "version": self._version}
-            if req.get("return_model") and self._version != report_version:
+            return self._push_grad_locked(req, grad)
+
+    def _push_grad_locked(self, req: dict, grad: np.ndarray) -> dict:  # edl-lint: disable=lock-discipline -- caller holds self._lock
+        """Serial gradient-report semantics (caller holds the lock):
+        the exactness reference the combined fast path must match."""
+        if self._vec is None:
+            raise ValueError("gradient pushed before shard init")
+        if self._is_duplicate(req):
+            resp = {"accepted": True, "version": self._version,
+                    "duplicate": True}
+            if req.get("return_model"):
                 resp["vec"] = self._wire_vec(req)
             return resp
+        if grad.shape != self._vec.shape:
+            raise ValueError(
+                f"grad slice shape {grad.shape} != {self._vec.shape}"
+            )
+        report_version = int(req.get("version", -1))
+        staleness = self._version - report_version
+        if self._use_async:
+            scale = 1.0
+            if self._lr_staleness_modulation and staleness > 1:
+                scale = 1.0 / float(staleness)
+            self._apply(grad * scale if scale != 1.0 else grad)
+        else:
+            # windowed sync: accumulate K reports; staleness beyond
+            # the window is down-weighted (window/staleness) rather
+            # than rejected — rejection cannot be atomic across
+            # shards (module docstring)
+            if self._staleness_window and staleness > self._staleness_window:
+                grad = grad * (self._staleness_window / float(staleness))
+            if self._grad_sum is None:
+                self._grad_sum = grad.copy()
+            else:
+                self._grad_sum += grad
+            self._grad_n += 1
+            if self._grad_n >= self._grads_to_wait:
+                self._apply(self._grad_sum / self._grad_n)
+                self._grad_sum = None
+                self._grad_n = 0
+        self._record_applied(req)
+        resp = {"accepted": True, "version": self._version}
+        if req.get("return_model") and self._version != report_version:
+            resp["vec"] = self._wire_vec(req)
+        return resp
 
     def push_delta(self, req: dict) -> dict:
         """Local-update window delta for this slice — mirrors
         MasterServicer.report_local_update: add, advance version by
         `steps`, hand the merged slice back when the pusher's base fell
-        behind (another worker synced in between)."""
+        behind (another worker synced in between).
+
+        With fan-in combining on, same-base concurrent deltas
+        rendezvous in the combine buffer and apply as one batch
+        (master/fanin.py)."""
         self._check_epoch(req)
+        # with no staleness window the delta apply is base-version-
+        # independent (base only shapes the response, and a combined
+        # member always gets the merged slice back), so the lineage key
+        # is just the kind + response dtype — concurrent cohorts stay
+        # in ONE group instead of fragmenting by base
+        if self._delta_combine is not None and not self._staleness_window:
+            key = ("delta", req.get("model_dtype") or "")
+            wire = req["delta"]
+            if isinstance(wire, codec.SparseDelta):
+                # top-k deltas enter the combine stage UN-densified:
+                # the presum scatter-adds just the k shipped entries
+                # per member (fanin.presum_f32), so the member cost
+                # scales with the compression ratio while the dense
+                # full-slice sweeps happen once per batch
+                return self._delta_combine.submit(key, req, wire)
+            return self._delta_combine.submit(
+                key, req, codec.delta_to_f32(wire)
+            )
+        # dense f32 passes through as a view; bf16 widens; int8 /
+        # top-k (QuantizedDelta / SparseDelta slices) decode to the
+        # dense f32 slice here, OUTSIDE the lock — the compression
+        # never leaks into the apply math
+        delta = codec.delta_to_f32(req["delta"])
+        with self._lock:
+            return self._push_delta_locked(req, delta)
+
+    def _push_delta_locked(self, req: dict, delta: np.ndarray) -> dict:  # edl-lint: disable=lock-discipline -- caller holds self._lock
+        """Serial window-delta semantics (caller holds the lock): the
+        exactness reference the combined fast path must match."""
+        if self._vec is None:
+            raise ValueError("delta pushed before shard init")
+        if self._is_duplicate(req):
+            # already applied: answer like a base-fell-behind merge
+            # so a retrying worker still rebases onto the result
+            return {
+                "version": self._version,
+                "vec": self._wire_vec(req),
+                "duplicate": True,
+            }
         steps = int(req["steps"])
         base_version = int(req["base_version"])
-        with self._lock:
-            if self._vec is None:
-                raise ValueError("delta pushed before shard init")
-            if self._is_duplicate(req):
-                # already applied: answer like a base-fell-behind merge
-                # so a retrying worker still rebases onto the result
-                return {
-                    "version": self._version,
-                    "vec": self._wire_vec(req),
-                    "duplicate": True,
-                }
-            # dense f32 passes through as a view; bf16 widens; int8 /
-            # top-k (QuantizedDelta / SparseDelta slices) decode to the
-            # dense f32 slice here — the compression never leaks into
-            # the apply math
-            delta = codec.delta_to_f32(req["delta"])
-            if delta.shape != self._vec.shape:
-                raise ValueError(
-                    f"delta slice shape {delta.shape} != {self._vec.shape}"
+        if delta.shape != self._vec.shape:
+            raise ValueError(
+                f"delta slice shape {delta.shape} != {self._vec.shape}"
+            )
+        scale = 1.0
+        if self._staleness_window:
+            staleness = self._version - base_version
+            if staleness > self._staleness_window:
+                scale = self._staleness_window / float(staleness)
+        self._vec += scale * delta if scale != 1.0 else delta
+        self._version += steps
+        self._record_applied(req)
+        resp = {"version": self._version}
+        if base_version + steps != self._version or req.get("want_model"):
+            resp["vec"] = self._wire_vec(req)
+        return resp
+
+    # -- fan-in combine appliers (fanin.CombineBuffer callbacks) -------------
+
+    def _apply_delta_batch(self, members) -> None:
+        """Apply k same-lineage window deltas in ONE lock acquisition.
+        The presum happens outside the lock; the fast path does one
+        vector add, advances the version by the summed steps, and
+        answers every member with one shared pre-packed merged slice.
+        Any anomaly — replayed report_key, staleness down-weighting
+        active, shape mismatch, uninitialized slice — falls back to
+        member-by-member serial semantics under the same single
+        acquisition, so dedup/exactness survive unchanged."""
+        acc = None
+        if len(members) > 1:
+            lens = [codec.delta_length(m.delta) for m in members]
+            if len(set(lens)) == 1:
+                # delta views are read-only (codec zero-copy); the
+                # presum builds one writable f32 accumulator, cache-
+                # blocked so the accumulator slice stays L2-resident
+                # across the dense adds; sparse (top-k) members
+                # scatter-add only their shipped entries
+                acc = fanin.presum_f32(
+                    [m.delta for m in members], n=lens[0]
                 )
-            scale = 1.0
-            if self._staleness_window:
-                staleness = self._version - base_version
-                if staleness > self._staleness_window:
-                    scale = self._staleness_window / float(staleness)
-            self._vec += scale * delta if scale != 1.0 else delta
-            self._version += steps
-            self._record_applied(req)
-            resp = {"version": self._version}
-            if base_version + steps != self._version or req.get("want_model"):
-                resp["vec"] = self._wire_vec(req)
-            return resp
+        shared_version = None
+        shared_vec = None
+        # a replay can share a batch with its original (client timed
+        # out while the original was still parked in the buffer): the
+        # fast path must see one key at most once or it double-applies
+        keys = [
+            m.req.get("report_key")
+            for m in members
+            if m.req.get("report_key")
+        ]
+        with self._lock:
+            self._combined_batches += 1
+            self._combined_reports += len(members)
+            fast = (
+                acc is not None
+                and self._vec is not None
+                and not self._staleness_window
+                and acc.shape == self._vec.shape
+                and len(keys) == len(set(keys))
+                and not any(k in self._seen_reports for k in keys)
+            )
+            if fast:
+                self._vec += acc
+                self._version += sum(int(m.req["steps"]) for m in members)
+                for m in members:
+                    self._record_applied(m.req)
+                shared_version = self._version
+                shared_vec = self._wire_vec(members[0].req)
+            else:
+                for m in members:
+                    try:
+                        # densify on demand: anomaly batches are rare
+                        # and must match serial semantics exactly
+                        m.resp = self._push_delta_locked(
+                            m.req, codec.delta_to_f32(m.delta)
+                        )
+                    except Exception as e:
+                        m.error = e
+        if fast:
+            # one serialization for the whole batch, done off-lock on
+            # the leader's thread: every member's base fell behind the
+            # combined version, so every member gets the merged slice —
+            # identical bytes, shared by reference
+            shared = messages.Prepacked(
+                messages.pack({"version": shared_version, "vec": shared_vec})
+            )
+            for m in members:
+                m.resp = shared
+
+    def _apply_grad_batch(self, members) -> None:
+        """Accumulate k same-version sync gradient reports in ONE lock
+        acquisition. The fast path is the pure-accumulate case (sync
+        mode, no staleness scaling, the batch stays strictly below the
+        grads_to_wait apply threshold, no model-down requested): adding
+        the presum IS the serial math. Everything else — async applies,
+        threshold crossings, replays — runs member-by-member under the
+        same single acquisition."""
+        acc = None
+        if len(members) > 1 and len({m.delta.shape for m in members}) == 1:
+            acc = fanin.presum_f32([m.delta for m in members])
+        # same intra-batch uniqueness requirement as the delta applier:
+        # a replay sharing a batch with its original must fall back
+        keys = [
+            m.req.get("report_key")
+            for m in members
+            if m.req.get("report_key")
+        ]
+        with self._lock:
+            self._combined_batches += 1
+            self._combined_reports += len(members)
+            fast = (
+                acc is not None
+                and self._vec is not None
+                and not self._use_async
+                and not self._staleness_window
+                and self._grad_n + len(members) < self._grads_to_wait
+                and acc.shape == self._vec.shape
+                and not any(m.req.get("return_model") for m in members)
+                and len(keys) == len(set(keys))
+                and not any(k in self._seen_reports for k in keys)
+            )
+            if fast:
+                if self._grad_sum is None:
+                    self._grad_sum = acc
+                else:
+                    self._grad_sum += acc
+                self._grad_n += len(members)
+                for m in members:
+                    self._record_applied(m.req)
+                version = self._version
+                for m in members:
+                    m.resp = {"accepted": True, "version": version}
+            else:
+                for m in members:
+                    try:
+                        m.resp = self._push_grad_locked(m.req, m.delta)
+                    except Exception as e:
+                        m.error = e
 
     # -- internals -----------------------------------------------------------
 
@@ -325,6 +520,10 @@ class PSShardServicer:
                 "duplicate_pushes": self._duplicate_pushes,
                 "version": self._version,
                 "generation": self.generation,
+                # fan-in combine ratio = combined_reports / batches
+                # (1.0 when combining is off or every batch had k=1)
+                "combined_batches": self._combined_batches,
+                "combined_reports": self._combined_reports,
             }
         if self._wire is not None:
             snap = self._wire.snapshot()

@@ -59,6 +59,12 @@ def ps_shard_parser() -> argparse.ArgumentParser:
         help="push dedup ring capacity (0 = servicer default; the "
         "group sizes it as num_workers x max in-flight syncs)",
     )
+    p.add_argument(
+        "--fanin_combine", action="store_true",
+        help="hierarchical fan-in: combine compatible concurrent "
+        "pushes outside the shard lock (master/fanin.py; default "
+        "honors EDL_FANIN_COMBINE)",
+    )
     return p
 
 
@@ -105,6 +111,8 @@ def main(argv=None) -> int:
         staleness_window=args.staleness_window,
         generation=args.generation,
         dedup_cap=args.dedup_cap or None,
+        # flag forces combining on; absent flag defers to the env knob
+        fanin_combine=True if args.fanin_combine else None,
     )
     server = RpcServer(servicer.handlers(), port=args.port)
     servicer.attach_wire_stats(server.wire)

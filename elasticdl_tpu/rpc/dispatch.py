@@ -1,0 +1,239 @@
+"""Event-loop dispatch core for the server side of the RPC plane.
+
+The blocking core (`EDL_DISPATCH=threads`, the default) holds one
+Python thread per in-flight request: the gRPC tier parks a pool thread
+in the handler, the UDS tier spawns a thread per connection, and the
+inproc tier runs the handler on the caller's thread. At fan-in scale
+(hundreds of workers reporting into one master) that is hundreds of
+runnable threads convoying on the GIL and the servicer locks long
+before the hardware saturates — ROADMAP item 5.
+
+`EDL_DISPATCH=loop` replaces that with a single asyncio event loop per
+process (`LoopCore`) serving every tier of `ServerDispatcher`
+(rpc/transport.py):
+
+- **uds** — connections are served by non-blocking socket reads on the
+  loop (`AsyncUdsServer`): thousands of idle connections cost no
+  threads.
+- **grpc** — a reactor shim: the sync gRPC pool thread submits the
+  dispatch coroutine to the loop and blocks on its future, so the loop
+  owns admission/scheduling while grpc keeps its wire stack.
+- **inproc** — direct scheduling: the caller's thread runs admission
+  and the handler inline (there is no socket to wait on, so a loop hop
+  would only add two context switches).
+
+Legacy sync handlers never run ON the loop: each dispatcher bridges
+them through its own BOUNDED executor (`EDL_DISPATCH_EXECUTOR` threads)
+so handler concurrency is a dial, not a per-request thread count.
+Chaos latency faults (`time.sleep` inside `transport_faults_before`)
+run inside the bridged handler job for the same reason — the
+async-discipline lint (analysis/async_discipline.py) flags blocking
+calls reachable from the loop's coroutines.
+
+Backpressure: before a request is admitted it passes a per-METHOD-CLASS
+bounded admission queue (`AdmissionQueues`): report-class mutations
+(push/report fan-in), pull-class reads (model-down), and control-plane
+calls each have their own in-flight cap (`EDL_QUEUE_DEPTH_*`). A full
+class rejects with RESOURCE_EXHAUSTED — retryable under the
+rpc/policy.py schedule, so clients back off deterministically instead
+of stacking threads on the server. Admission is checked before the
+executor is touched: shed load costs O(1), never a queue slot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import threading
+from typing import Dict, Optional
+
+import grpc
+
+from elasticdl_tpu.common.constants import (
+    ENV_DISPATCH,
+    ENV_DISPATCH_EXECUTOR,
+    ENV_QUEUE_DEPTH_CONTROL,
+    ENV_QUEUE_DEPTH_PULL,
+    ENV_QUEUE_DEPTH_REPORT,
+)
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.rpc.policy import PolicyRpcError
+
+logger = get_logger(__name__)
+
+DISPATCH_THREADS = "threads"
+DISPATCH_LOOP = "loop"
+
+#: Method classes for admission control. Report-class methods are the
+#: fan-in mutations (bounded high: every worker may have several
+#: pipelined reports in flight); pull-class are the big reads;
+#: everything unlisted is control-plane.
+CLASS_REPORT = "report"
+CLASS_PULL = "pull"
+CLASS_CONTROL = "control"
+
+_REPORT_METHODS = frozenset(
+    {
+        "PSPushGrad",
+        "PSPushDelta",
+        "ReportGradient",
+        "ReportLocalUpdate",
+        "ReportWindowMeta",
+        "ReportVariable",
+        "ReportEvaluationMetrics",
+        "ReportTaskResult",
+        "EmbeddingUpdate",
+        "KVUpdate",
+        "KVMirror",
+        "PSRestoreFromWorker",
+    }
+)
+_PULL_METHODS = frozenset(
+    {
+        "GetModel",
+        "PSPull",
+        "PSOptState",
+        "EmbeddingLookup",
+        "KVLookup",
+        "KVSnapshot",
+        "KVMirrorSnapshot",
+        "GetSampleBatch",
+        "GetAux",
+    }
+)
+
+_DEPTH_DEFAULTS = {CLASS_REPORT: 1024, CLASS_PULL: 256, CLASS_CONTROL: 256}
+_DEPTH_ENVS = {
+    CLASS_REPORT: ENV_QUEUE_DEPTH_REPORT,
+    CLASS_PULL: ENV_QUEUE_DEPTH_PULL,
+    CLASS_CONTROL: ENV_QUEUE_DEPTH_CONTROL,
+}
+
+
+def dispatch_mode(env=None) -> str:
+    """The configured server dispatch core ("threads"/"loop"); unknown
+    values log once and mean threads."""
+    env = os.environ if env is None else env
+    mode = (env.get(ENV_DISPATCH, "") or DISPATCH_THREADS).strip().lower()
+    if mode not in (DISPATCH_THREADS, DISPATCH_LOOP):
+        logger.warning("unknown %s=%r; using threads", ENV_DISPATCH, mode)
+        return DISPATCH_THREADS
+    return mode
+
+
+def executor_width(env=None) -> int:
+    env = os.environ if env is None else env
+    raw = env.get(ENV_DISPATCH_EXECUTOR, "")
+    try:
+        width = int(raw) if raw else 32
+    except ValueError:
+        logger.warning("bad %s=%r; using 32", ENV_DISPATCH_EXECUTOR, raw)
+        width = 32
+    return max(1, width)
+
+
+def method_class(method: str) -> str:
+    if method in _REPORT_METHODS:
+        return CLASS_REPORT
+    if method in _PULL_METHODS:
+        return CLASS_PULL
+    return CLASS_CONTROL
+
+
+class AdmissionQueues:
+    """Per-method-class bounded in-flight counters. `enter` admits or
+    rejects with RESOURCE_EXHAUSTED (never blocks — backpressure is the
+    client's retry schedule, not a server-side wait); `leave` releases
+    the slot. Thread-safe: the inproc tier admits on caller threads
+    while the loop admits socket/grpc requests."""
+
+    def __init__(self, env=None):
+        env = os.environ if env is None else env
+        self._depths: Dict[str, int] = {}
+        for cls, default in _DEPTH_DEFAULTS.items():
+            raw = env.get(_DEPTH_ENVS[cls], "")
+            try:
+                depth = int(raw) if raw else default
+            except ValueError:
+                logger.warning(
+                    "bad %s=%r; using %d", _DEPTH_ENVS[cls], raw, default
+                )
+                depth = default
+            self._depths[cls] = max(1, depth)
+        self._lock = threading.Lock()
+        self._inflight = {cls: 0 for cls in _DEPTH_DEFAULTS}
+        self._rejected = {cls: 0 for cls in _DEPTH_DEFAULTS}
+
+    def depth(self, cls: str) -> int:
+        return self._depths[cls]
+
+    def enter(self, method: str) -> str:
+        """Admit `method` and return its class (pass to `leave`), or
+        raise RESOURCE_EXHAUSTED if the class queue is full."""
+        cls = method_class(method)
+        with self._lock:
+            if self._inflight[cls] >= self._depths[cls]:
+                self._rejected[cls] += 1
+                raise PolicyRpcError(
+                    grpc.StatusCode.RESOURCE_EXHAUSTED,
+                    f"{cls} admission queue full "
+                    f"({self._depths[cls]} in flight); retry with backoff",
+                )
+            self._inflight[cls] += 1
+        return cls
+
+    def leave(self, cls: str) -> None:
+        with self._lock:
+            self._inflight[cls] -= 1
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                cls: {
+                    "depth": self._depths[cls],
+                    "inflight": self._inflight[cls],
+                    "rejected": self._rejected[cls],
+                }
+                for cls in self._depths
+            }
+
+
+class LoopCore:
+    """The process's dispatch event loop: one daemon thread running one
+    asyncio loop, shared by every loop-mode ServerDispatcher and
+    AsyncUdsServer in the process (a master hosting N inproc shard
+    servers still runs ONE loop). Handler work never runs here — only
+    admission, socket IO, and scheduling into per-dispatcher bounded
+    executors."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name="edl-dispatch-loop", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def on_loop_thread(self) -> bool:
+        return threading.current_thread() is self._thread
+
+    def submit(self, coro):
+        """Schedule a coroutine from any non-loop thread; returns a
+        concurrent.futures.Future."""
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+
+_core_lock = threading.Lock()
+_core: Optional[LoopCore] = None
+
+
+def get_loop_core() -> LoopCore:
+    """The lazily-started process-wide LoopCore."""
+    global _core
+    with _core_lock:
+        if _core is None:
+            _core = LoopCore()
+        return _core

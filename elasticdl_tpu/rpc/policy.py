@@ -47,9 +47,16 @@ from elasticdl_tpu.common.constants import (
 
 #: Status codes worth re-sending an idempotent call for. INTERNAL is
 #: deliberately absent: a handler exception is deterministic — retrying
-#: re-raises it N times and hides the real error.
+#: re-raises it N times and hides the real error. RESOURCE_EXHAUSTED is
+#: the loop dispatcher's admission-queue backpressure (rpc/dispatch.py):
+#: the server sheds load it will accept again once the queue drains, so
+#: backing off and re-sending is exactly right.
 RETRYABLE_CODES: FrozenSet[grpc.StatusCode] = frozenset(
-    {grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED}
+    {
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.RESOURCE_EXHAUSTED,
+    }
 )
 
 #: Method-level idempotency classification (the request shapes make
@@ -125,15 +132,23 @@ class WireStats:
     in-process call reports zero wire bytes but still counts its call
     (callers pass `calls=1` explicitly there, since the default
     heuristic counts a call per non-empty send). Thread-safe;
-    snapshot() returns plain dicts for stats()/bench JSON surfaces."""
+    snapshot() returns plain dicts for stats()/bench JSON surfaces.
+
+    Counters are STRIPED (lock per stripe, threads pinned round-robin
+    to stripes): every RPC on every tier records here, so under the
+    loop-dispatch fan-in hundreds of concurrent recorders would
+    otherwise convoy on one accounting mutex. snapshot() merges the
+    stripes — its output shape is unchanged."""
+
+    _NUM_STRIPES = 8
 
     def __init__(self, endpoint: str = ""):
         self.endpoint = endpoint
-        self._lock = threading.Lock()
-        # method -> [bytes_sent, bytes_received, calls]
-        self._methods: dict = {}
-        # transport tier -> [bytes_sent, bytes_received, calls]
-        self._transports: dict = {}
+        # stripe -> (lock, method -> [sent, recv, calls],
+        #           transport tier -> [sent, recv, calls])
+        self._stripes = [
+            (threading.Lock(), {}, {}) for _ in range(self._NUM_STRIPES)
+        ]
 
     def record(
         self,
@@ -144,30 +159,42 @@ class WireStats:
         calls=None,
     ):
         n = (1 if sent else 0) if calls is None else int(calls)
-        with self._lock:
-            row = self._methods.get(method)
+        lock, methods, transports = self._stripes[_stripe_index()]
+        with lock:
+            row = methods.get(method)
             if row is None:
-                row = self._methods[method] = [0, 0, 0]
+                row = methods[method] = [0, 0, 0]
             row[0] += int(sent)
             row[1] += int(received)
             row[2] += n
-            trow = self._transports.get(transport)
+            trow = transports.get(transport)
             if trow is None:
-                trow = self._transports[transport] = [0, 0, 0]
+                trow = transports[transport] = [0, 0, 0]
             trow[0] += int(sent)
             trow[1] += int(received)
             trow[2] += n
 
     def snapshot(self) -> dict:
-        with self._lock:
-            methods = {
-                m: {"bytes_sent": r[0], "bytes_received": r[1], "calls": r[2]}
-                for m, r in self._methods.items()
-            }
-            transports = {
-                t: {"bytes_sent": r[0], "bytes_received": r[1], "calls": r[2]}
-                for t, r in self._transports.items()
-            }
+        methods: dict = {}
+        transports: dict = {}
+        for lock, smethods, stransports in self._stripes:
+            with lock:
+                srows = [(m, list(r)) for m, r in smethods.items()]
+                trows = [(t, list(r)) for t, r in stransports.items()]
+            for m, r in srows:
+                agg = methods.setdefault(
+                    m, {"bytes_sent": 0, "bytes_received": 0, "calls": 0}
+                )
+                agg["bytes_sent"] += r[0]
+                agg["bytes_received"] += r[1]
+                agg["calls"] += r[2]
+            for t, r in trows:
+                agg = transports.setdefault(
+                    t, {"bytes_sent": 0, "bytes_received": 0, "calls": 0}
+                )
+                agg["bytes_sent"] += r[0]
+                agg["bytes_received"] += r[1]
+                agg["calls"] += r[2]
         return {
             "endpoint": self.endpoint,
             "bytes_sent": sum(v["bytes_sent"] for v in methods.values()),
@@ -180,9 +207,29 @@ class WireStats:
         }
 
     def reset(self):
-        with self._lock:
-            self._methods.clear()
-            self._transports.clear()
+        for lock, methods, transports in self._stripes:
+            with lock:
+                methods.clear()
+                transports.clear()
+
+
+# Threads are pinned to stripes round-robin at first record: cheaper
+# and better-spread than hashing thread ids (CPython idents are
+# pointer-aligned, so their low bits collide).
+_stripe_tl = threading.local()
+_stripe_seq_lock = threading.Lock()
+_stripe_seq = 0
+
+
+def _stripe_index() -> int:
+    idx = getattr(_stripe_tl, "idx", None)
+    if idx is None:
+        global _stripe_seq
+        with _stripe_seq_lock:
+            idx = _stripe_seq % WireStats._NUM_STRIPES
+            _stripe_seq += 1
+        _stripe_tl.idx = idx
+    return idx
 
 
 _wire_registry_lock = threading.Lock()

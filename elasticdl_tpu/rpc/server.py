@@ -95,8 +95,16 @@ class RpcServer:
         transport_mod.register_inproc(self.port, self._dispatcher)
         self._uds = None
         if transport_mod.server_fast_paths_enabled():
+            # loop dispatch serves UDS with non-blocking reads on the
+            # process event loop; threads dispatch keeps the blocking
+            # thread-per-connection listener (rpc/dispatch.py)
+            uds_cls = (
+                transport_mod.AsyncUdsServer
+                if self._dispatcher.mode == "loop"
+                else transport_mod.UdsServer
+            )
             try:
-                self._uds = transport_mod.UdsServer(self.port, self._dispatcher)
+                self._uds = uds_cls(self.port, self._dispatcher)
             except OSError as e:
                 logger.warning(
                     "UDS fast path unavailable for port %s (%s); gRPC only",
@@ -119,6 +127,7 @@ class RpcServer:
         if self._uds is not None:
             self._uds.close()
         self._server.stop(grace)
+        self._dispatcher.close()
 
     def wait(self):
         self._server.wait_for_termination()

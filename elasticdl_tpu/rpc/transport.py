@@ -36,11 +36,13 @@ inproc > uds > grpc.
 
 from __future__ import annotations
 
+import asyncio
 import os
 import socket
 import struct
 import tempfile
 import threading
+from concurrent import futures
 from typing import Callable, Dict, Optional
 
 import grpc
@@ -48,6 +50,7 @@ import grpc
 from elasticdl_tpu.common import messages
 from elasticdl_tpu.common.constants import ENV_TRANSPORT, ENV_UDS_DIR
 from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.rpc import dispatch as dispatch_mod
 from elasticdl_tpu.rpc.chaos import (
     transport_faults_after,
     transport_faults_before,
@@ -122,23 +125,111 @@ class ServerDispatcher:
     For the grpc tier the chaos server interceptor already wraps the
     handler, so dispatch applies server-side faults only for the fast
     paths — exactly one injection layer per tier.
+
+    Two dispatch cores (`EDL_DISPATCH`, rpc/dispatch.py): `threads`
+    (default) runs the handler on whatever thread delivered the bytes —
+    the blocking thread-per-request model. `loop` serves every tier
+    from the process event loop: requests pass per-method-class bounded
+    admission queues (full -> RESOURCE_EXHAUSTED, retryable), sync
+    handlers are bridged through this dispatcher's bounded executor,
+    uds connections are read non-blocking on the loop
+    (`AsyncUdsServer`), grpc pool threads park on a loop future (the
+    reactor shim), and inproc callers run admission + handler inline
+    (direct scheduling — no socket, so no loop hop).
     """
 
-    def __init__(self, handlers: Dict[str, Callable], wire, fault_plan=None):
+    def __init__(
+        self,
+        handlers: Dict[str, Callable],
+        wire,
+        fault_plan=None,
+        mode: Optional[str] = None,
+    ):
         self._handlers = dict(handlers)
         self._wire = wire
         self._plan = fault_plan
+        self._mode = dispatch_mod.dispatch_mode() if mode is None else mode
+        self._admission = None
+        self._executor = None
+        self._core = None
+        if self._mode == dispatch_mod.DISPATCH_LOOP:
+            self._admission = dispatch_mod.AdmissionQueues()
+            self._executor = futures.ThreadPoolExecutor(
+                max_workers=dispatch_mod.executor_width(),
+                thread_name_prefix="edl-dispatch-exec",
+            )
+            self._core = dispatch_mod.get_loop_core()
+
+    @property
+    def mode(self) -> str:
+        return self._mode
 
     def methods(self) -> frozenset:
         return frozenset(self._handlers)
 
+    def admission_stats(self) -> Optional[dict]:
+        return None if self._admission is None else self._admission.stats()
+
+    def close(self):
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+
     def dispatch(self, method: str, request_bytes, transport: str) -> bytes:
+        if self._core is not None:
+            if transport == TRANSPORT_INPROC:
+                # direct scheduling: there is no socket to multiplex, so
+                # the caller's thread runs admission + handler inline —
+                # a loop hop would only add two context switches
+                cls = self._admission.enter(method)
+                try:
+                    return self._dispatch_blocking(
+                        method, request_bytes, transport
+                    )
+                finally:
+                    self._admission.leave(cls)
+            if not self._core.on_loop_thread():
+                # reactor shim (grpc tier): the pool thread parks on the
+                # loop's future; admission/scheduling happen on the loop
+                return self._core.submit(
+                    self.dispatch_async(method, request_bytes, transport)
+                ).result()
+            # on the loop thread itself fall through to inline dispatch
+            # (loop-side callers normally await dispatch_async)
         after = []
         if transport != TRANSPORT_GRPC:
             after = transport_faults_before(self._plan, method, "server")
         resp_bytes = self._invoke(method, request_bytes, transport)
         # drop/crash-after fire with the handler APPLIED (same contract
         # as the server interceptor: state changed, response withheld)
+        transport_faults_after(after, method)
+        return resp_bytes
+
+    async def dispatch_async(
+        self, method: str, request_bytes, transport: str
+    ) -> bytes:
+        """Loop-mode dispatch: admission on the loop, then the blocking
+        half (chaos hooks + legacy sync handler) bridged through the
+        bounded executor — handler work and chaos latency sleeps never
+        run ON the loop (async-discipline lint)."""
+        cls = self._admission.enter(method)
+        try:
+            return await self._core.loop.run_in_executor(
+                self._executor,
+                self._dispatch_blocking,
+                method,
+                request_bytes,
+                transport,
+            )
+        finally:
+            self._admission.leave(cls)
+
+    def _dispatch_blocking(
+        self, method: str, request_bytes, transport: str
+    ) -> bytes:
+        after = []
+        if transport != TRANSPORT_GRPC:
+            after = transport_faults_before(self._plan, method, "server")
+        resp_bytes = self._invoke(method, request_bytes, transport)
         transport_faults_after(after, method)
         return resp_bytes
 
@@ -236,6 +327,19 @@ class InprocTransport:
 # uds: length-prefixed codec frames over AF_UNIX
 
 
+def _error_frame(e: grpc.RpcError) -> bytes:
+    """The UDS error response frame for a dispatch failure — enough to
+    rebuild the PolicyRpcError the gRPC tier would have surfaced."""
+    code = e.code() if callable(getattr(e, "code", None)) else None
+    if not isinstance(code, grpc.StatusCode):
+        code = grpc.StatusCode.INTERNAL
+    details = ""
+    if callable(getattr(e, "details", None)):
+        details = e.details() or ""
+    detail_b = details.encode("utf-8")[:1024]
+    return _RESP_ERR.pack(1, code.value[0], len(detail_b)) + detail_b
+
+
 def _recv_exact(conn: socket.socket, n: int, *, eof_ok: bool = False):
     """Read exactly n bytes; None on a clean EOF at a frame boundary
     (eof_ok), ConnectionError on EOF mid-frame."""
@@ -315,16 +419,7 @@ class UdsServer:
                 try:
                     resp = self._dispatcher.dispatch(method, body, TRANSPORT_UDS)
                 except grpc.RpcError as e:
-                    code = e.code() if callable(getattr(e, "code", None)) else None
-                    if not isinstance(code, grpc.StatusCode):
-                        code = grpc.StatusCode.INTERNAL
-                    details = ""
-                    if callable(getattr(e, "details", None)):
-                        details = e.details() or ""
-                    detail_b = details.encode("utf-8")[:1024]
-                    conn.sendall(
-                        _RESP_ERR.pack(1, code.value[0], len(detail_b)) + detail_b
-                    )
+                    conn.sendall(_error_frame(e))
                     continue
                 conn.sendall(_RESP_OK.pack(0, len(resp)))
                 conn.sendall(resp)
@@ -355,6 +450,106 @@ class UdsServer:
             os.unlink(self.path)
         except OSError:
             pass
+
+
+class AsyncUdsServer:
+    """Event-loop Unix-domain-socket listener (`EDL_DISPATCH=loop`):
+    the same framing and close semantics as UdsServer, but connections
+    are read with non-blocking socket IO on the process LoopCore — N
+    idle worker connections cost zero threads instead of N. Requests
+    are served through the shared ServerDispatcher's async path
+    (admission queues + bounded handler executor), so chaos, fencing,
+    and abort classification stay tier-identical. Raises OSError from
+    __init__ when the socket path is unusable, like UdsServer."""
+
+    #: Touched only from LoopCore coroutines after construction; the
+    #: async-discipline lint flags executor-bridged code reaching them.
+    LOOP_ONLY_ATTRS = ("_server", "_writers")
+
+    def __init__(self, port: int, dispatcher: ServerDispatcher, core=None):
+        self.path = uds_path_for(port)
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.path)
+        self._sock.listen(128)
+        self._sock.setblocking(False)
+        self._dispatcher = dispatcher
+        self._core = core if core is not None else dispatch_mod.get_loop_core()
+        self._server = None
+        # live connection writers, severed on close(): a stopped server
+        # must refuse pooled clients exactly like a stopped gRPC server
+        self._writers: set = set()
+        self._closed = False
+
+    def start(self):
+        self._core.submit(self._start_async()).result(timeout=10)
+
+    async def _start_async(self):
+        self._server = await asyncio.start_unix_server(
+            self._serve_conn, sock=self._sock
+        )
+
+    async def _serve_conn(self, reader, writer):
+        if self._closed:
+            writer.close()
+            return
+        self._writers.add(writer)
+        try:
+            while not self._closed:
+                try:
+                    header = await reader.readexactly(_REQ_HEADER.size)
+                except asyncio.IncompleteReadError as e:
+                    if e.partial:
+                        logger.warning(
+                            "uds peer closed mid-header (%d bytes)",
+                            len(e.partial),
+                        )
+                    return
+                mlen, blen = _REQ_HEADER.unpack(header)
+                method = (await reader.readexactly(mlen)).decode("utf-8")
+                body = await reader.readexactly(blen)
+                try:
+                    resp = await self._dispatcher.dispatch_async(
+                        method, body, TRANSPORT_UDS
+                    )
+                except grpc.RpcError as e:
+                    writer.write(_error_frame(e))
+                    await writer.drain()
+                    continue
+                writer.write(_RESP_OK.pack(0, len(resp)))
+                writer.write(resp)
+                await writer.drain()
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass  # client went away; per-connection state is none
+        finally:
+            self._writers.discard(writer)
+            try:
+                writer.close()
+            except OSError:  # pragma: no cover
+                pass
+
+    def close(self):
+        try:
+            self._core.submit(self._close_async()).result(timeout=5)
+        except Exception:  # pragma: no cover - loop already gone
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    async def _close_async(self):
+        self._closed = True
+        if self._server is not None:
+            self._server.close()
+        for w in list(self._writers):
+            try:
+                w.close()
+            except OSError:  # pragma: no cover
+                pass
 
 
 class UdsTransport:

@@ -1,0 +1,46 @@
+"""async-discipline positive fixture: `serve` is a coroutine that
+sleeps on the loop and makes a sync RPC two frames down (`_relay` ->
+`_push`) — visible only ACROSS the call boundary; `poll` parks on an
+unbounded `.acquire()`; `Listener.reset` is a sync method touching
+`_writers`, declared loop-confined. Loaded as source by
+tests/test_static_analysis.py; never imported."""
+
+import time
+
+
+class S:
+    def handlers(self):
+        return {"Ping": self.ping}
+
+    def ping(self, req):
+        return {"x": req.get("x")}
+
+
+def _push(client):
+    return client.call("Ping", {})
+
+
+def _relay(client):
+    return _push(client)
+
+
+class Listener:
+    LOOP_ONLY_ATTRS = ("_writers",)
+
+    def __init__(self, lock):
+        self._lock = lock
+        self._writers = set()
+
+    async def serve(self, client):
+        time.sleep(0.1)  # chaos latency fault running ON the loop
+        return _relay(client)
+
+    async def poll(self):
+        self._lock.acquire()  # unbounded park: the loop stops turning
+        try:
+            return len(self._writers)
+        finally:
+            self._lock.release()
+
+    def reset(self):
+        self._writers.clear()  # sync method racing the loop

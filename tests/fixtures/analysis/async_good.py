@@ -1,0 +1,44 @@
+"""async-discipline clean twin: awaited calls are async APIs (not
+blocking), blocking work crosses the loop boundary only as a function
+REFERENCE handed to run_in_executor (no call edge), bounded `.acquire`
+forms are deliberate, and the loop-confined attributes are touched
+only from coroutines and __init__. Loaded as source by
+tests/test_static_analysis.py; never imported."""
+
+import time
+
+
+class S:
+    def handlers(self):
+        return {"Ping": self.ping}
+
+    def ping(self, req):
+        return {"x": req.get("x")}
+
+
+def _blocking_half(client):
+    time.sleep(0.01)  # runs on the executor, off the loop
+    return client.call("Ping", {})
+
+
+class Listener:
+    LOOP_ONLY_ATTRS = ("_writers",)
+
+    def __init__(self, loop, executor, lock):
+        self._loop = loop
+        self._executor = executor
+        self._lock = lock
+        self._writers = set()
+
+    async def serve(self, client, event):
+        await event.wait()  # asyncio wait: yields to the loop
+        return await self._loop.run_in_executor(
+            self._executor, _blocking_half, client
+        )
+
+    async def track(self, writer):
+        self._writers.add(writer)  # loop-confined, touched on-loop
+
+    def try_note(self):
+        if self._lock.acquire(timeout=0.1):  # bounded: deliberate
+            self._lock.release()

@@ -851,3 +851,222 @@ def test_shard_failover(tmp_path, monkeypatch):
     # once — KV row values are bounded-staleness, versions are not
     assert under_chaos["versions"] == fault_free["versions"] == [16, 16]
     assert fault_free["recoveries"] == []
+
+
+# -- fan-in combine under chaos, per wire codec -------------------------------
+
+
+def _encode_slice(codec_name: str, dense: "np.ndarray", seed: int):
+    """One worker's per-shard wire delta in the named codec. `dense`
+    is the exactly-representable f32 slice the worker means to push;
+    the wire form is what actually crosses (lossy for int8 forms)."""
+    import ml_dtypes
+
+    from elasticdl_tpu.common import codec
+
+    if codec_name == "f32":
+        return dense
+    if codec_name == "bf16":
+        # the fixture values fit bf16's mantissa exactly
+        return dense.astype(ml_dtypes.bfloat16)
+    if codec_name == "int8":
+        return codec.quantize_int8(dense)
+    # top-k forms: ship a deterministic 25% support
+    rng = np.random.default_rng(seed)
+    k = max(1, dense.size // 4)
+    idx = np.sort(rng.choice(dense.size, size=k, replace=False))
+    vals = dense[idx]
+    if codec_name == "topk":
+        return codec.SparseDelta(
+            indices=idx.astype(np.int64), values=vals, n=dense.size
+        )
+    assert codec_name == "topk_int8"
+    return codec.SparseDelta(
+        indices=idx.astype(np.int64),
+        values=codec.quantize_int8(vals),
+        n=dense.size,
+    )
+
+
+def _fanin_chaos_job(codec_name: str, combine: bool):
+    """In-process fan-in mini-job over 2 PS shard servicers: 6 worker
+    threads push 8 rounds of codec-encoded window deltas, every third
+    report is replayed (the drop-retry pattern — sometimes landing in
+    the SAME combine batch as its original), and shard 1 fails over
+    mid-job: fenced at a bumped generation, restored from its own
+    state (what the recovery plane's restore does), with the torn
+    report replayed under its pinned key. Returns final versions, the
+    assembled model, and the dedup/combine counters."""
+    import threading
+
+    from elasticdl_tpu.master.ps_shard import (
+        PSShardServicer,
+        slice_boundaries,
+    )
+    from elasticdl_tpu.rpc.fencing import EpochFencedError
+
+    n_params, n_workers, n_rounds = 96, 6, 8
+    bounds = slice_boundaries(n_params, 2)
+    shards = [
+        PSShardServicer(i, 2, fanin_combine=combine, generation=0)
+        for i in range(2)
+    ]
+    epochs = [0, 0]
+    for i, (s0, s1) in enumerate(bounds):
+        shards[i].init_slice(
+            {"vec": np.zeros(s1 - s0, np.float32), "version": 0}
+        )
+    delta_unit = 2.0 ** -12  # exactly representable at any sum order
+
+    def push_all(wid, rnd, errors=None):
+        """One worker's windowed report: codec-encode each slice and
+        push with a pinned report key; replay every third report."""
+        rng = np.random.default_rng(1000 * wid + rnd)
+        dense = (
+            rng.integers(-32, 32, size=n_params) * delta_unit
+        ).astype(np.float32)
+        for sid, (s0, s1) in enumerate(bounds):
+            wire = _encode_slice(
+                codec_name, dense[s0:s1], seed=97 * wid + rnd
+            )
+            req = {
+                "delta": wire,
+                "steps": 1,
+                "base_version": 0,
+                "report_key": f"w{wid}:r{rnd}",
+                "epoch": epochs[sid],
+            }
+            try:
+                shards[sid].push_delta(dict(req))
+                if (wid + rnd) % 3 == 0:
+                    # drop-retry: the response was lost, the worker
+                    # resends the SAME keyed report
+                    shards[sid].push_delta(dict(req))
+            except Exception as e:  # pragma: no cover - assertion surface
+                if errors is not None:
+                    errors.append(repr(e))
+                else:
+                    raise
+
+    def failover_shard_1():
+        """Tear down shard 1 mid-job and relaunch it fenced: new
+        servicer at generation 1, restored from the dead shard's
+        state; the report torn across the fan-out is replayed."""
+        torn = {
+            "steps": 1,
+            "base_version": 0,
+            "report_key": "torn:0",
+        }
+        s0, s1 = bounds[0]
+        shards[0].push_delta(
+            dict(
+                torn,
+                delta=_encode_slice(
+                    codec_name,
+                    np.full(s1 - s0, delta_unit, np.float32),
+                    seed=7,
+                ),
+                epoch=epochs[0],
+            )
+        )
+        # shard 1 "crashed" before applying its half of the report
+        old = shards[1]
+        state = old.pull({})
+        shards[1] = PSShardServicer(
+            1, 2, fanin_combine=combine, generation=1
+        )
+        shards[1].init_slice(
+            {"vec": state["vec"], "version": state["version"]}
+        )
+        epochs[1] = 1
+        # the stale epoch bounces off the fence (clients re-resolve)
+        with pytest.raises(EpochFencedError):
+            shards[1].push_delta(
+                {
+                    "delta": np.zeros(
+                        bounds[1][1] - bounds[1][0], np.float32
+                    ),
+                    "steps": 1,
+                    "base_version": 0,
+                    "epoch": 0,
+                }
+            )
+        # torn-report replay under the pinned key: shard 0 dedups,
+        # shard 1 applies for the first time
+        for sid, (s0, s1) in enumerate(bounds):
+            shards[sid].push_delta(
+                dict(
+                    torn,
+                    delta=_encode_slice(
+                        codec_name,
+                        np.full(s1 - s0, delta_unit, np.float32),
+                        seed=7,
+                    ),
+                    epoch=epochs[sid],
+                )
+            )
+
+    for rnd in range(n_rounds):
+        if rnd == n_rounds // 2:
+            failover_shard_1()
+        if combine:
+            errors = []
+            threads = [
+                threading.Thread(target=push_all, args=(w, rnd, errors))
+                for w in range(n_workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert errors == []
+        else:
+            for w in range(n_workers):
+                push_all(w, rnd)
+
+    stats = [s.stats() for s in shards]
+    return {
+        "versions": [s["version"] for s in stats],
+        "vec": np.concatenate([s.pull({})["vec"] for s in shards]),
+        "duplicates": sum(s["duplicate_pushes"] for s in stats),
+        "applied": sum(s["applied_pushes"] for s in stats),
+        "combined_reports": sum(s["combined_reports"] for s in stats),
+    }
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize(
+    "codec_name", ["f32", "bf16", "int8", "topk", "topk_int8"]
+)
+def test_fanin_combine_chaos_matches_serial(codec_name):
+    """The fan-in combine stage under chaos, per wire codec: replayed
+    reports (drop-retry, including replays sharing a batch with their
+    original) plus a mid-job fenced shard failover must land the
+    combined path at EXACTLY the serial path's versions and accounting,
+    with the model bit-identical for exactly-representable wire values
+    (f32/bf16/topk) and trajectory-identical (same versions, same
+    applies, numerically equal sums) for the lossy int8 forms."""
+    combined = _fanin_chaos_job(codec_name, combine=True)
+    serial = _fanin_chaos_job(codec_name, combine=False)
+
+    # exactly-once accounting, identical on both paths: versions are
+    # 6 workers x 8 rounds + the torn report = 49 per shard (the
+    # restored shard RESUMES its version; its counters restart at the
+    # relaunch, so applied = 49 on shard 0 + 24 post-failover rounds
+    # + the torn apply = 25 on the new shard 1)
+    assert combined["versions"] == serial["versions"] == [49, 49]
+    assert combined["applied"] == serial["applied"] == 74
+    # every replay was absorbed by the dedup ring, not double-applied:
+    # (w+r)%3==0 gives 2 replays/round -> 16 on shard 0 + 8 on the
+    # post-failover shard 1, plus the torn-report replay deduping on
+    # the surviving shard 0
+    assert combined["duplicates"] == serial["duplicates"] == 25
+    # the combined run actually combined
+    assert combined["combined_reports"] > 0
+    assert serial["combined_reports"] == 0
+    if codec_name in ("f32", "bf16", "topk"):
+        np.testing.assert_array_equal(combined["vec"], serial["vec"])
+    else:
+        np.testing.assert_allclose(
+            combined["vec"], serial["vec"], rtol=1e-6, atol=1e-7
+        )

@@ -1,0 +1,301 @@
+"""Event-loop dispatch core tests (rpc/dispatch.py + the loop paths of
+rpc/transport.py): mode/width/class configuration, bounded admission
+queues rejecting with retryable RESOURCE_EXHAUSTED, and full
+client-server round-trips over the uds and inproc tiers with
+``EDL_DISPATCH=loop`` — same failure semantics (fencing ->
+FAILED_PRECONDITION, handler bug -> sanitized INTERNAL) as the
+blocking core, which is the whole point of the swap."""
+
+import threading
+
+import grpc
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.constants import (
+    ENV_DISPATCH,
+    ENV_DISPATCH_EXECUTOR,
+    ENV_QUEUE_DEPTH_CONTROL,
+    ENV_QUEUE_DEPTH_REPORT,
+    ENV_TRANSPORT,
+    ENV_UDS_DIR,
+)
+from elasticdl_tpu.rpc import dispatch
+from elasticdl_tpu.rpc.client import RpcClient
+from elasticdl_tpu.rpc.fencing import EpochFencedError, is_fenced_error
+from elasticdl_tpu.rpc.policy import (
+    RETRYABLE_CODES,
+    PolicyRpcError,
+    RetryPolicy,
+)
+from elasticdl_tpu.rpc.server import RpcServer
+
+
+def fast_policy(**kw):
+    kw.setdefault("initial_backoff", 0.01)
+    kw.setdefault("max_backoff", 0.05)
+    return RetryPolicy(**kw)
+
+
+# -- configuration ------------------------------------------------------------
+
+
+def test_dispatch_mode_default_loop_and_unknown():
+    assert dispatch.dispatch_mode({}) == dispatch.DISPATCH_THREADS
+    assert dispatch.dispatch_mode({ENV_DISPATCH: "loop"}) == (
+        dispatch.DISPATCH_LOOP
+    )
+    assert dispatch.dispatch_mode({ENV_DISPATCH: " LOOP "}) == (
+        dispatch.DISPATCH_LOOP
+    )
+    # unknown values degrade to the blocking core, never crash startup
+    assert dispatch.dispatch_mode({ENV_DISPATCH: "warp"}) == (
+        dispatch.DISPATCH_THREADS
+    )
+
+
+def test_executor_width_default_override_and_bad():
+    assert dispatch.executor_width({}) == 32
+    assert dispatch.executor_width({ENV_DISPATCH_EXECUTOR: "4"}) == 4
+    assert dispatch.executor_width({ENV_DISPATCH_EXECUTOR: "0"}) == 1
+    assert dispatch.executor_width({ENV_DISPATCH_EXECUTOR: "lots"}) == 32
+
+
+def test_method_class_classification():
+    assert dispatch.method_class("PSPushDelta") == dispatch.CLASS_REPORT
+    assert dispatch.method_class("ReportGradient") == dispatch.CLASS_REPORT
+    assert dispatch.method_class("PSPull") == dispatch.CLASS_PULL
+    assert dispatch.method_class("GetModel") == dispatch.CLASS_PULL
+    # anything unlisted is control-plane (smallest default queue)
+    assert dispatch.method_class("GetTask") == dispatch.CLASS_CONTROL
+    assert dispatch.method_class("NoSuchMethod") == dispatch.CLASS_CONTROL
+
+
+# -- admission queues ---------------------------------------------------------
+
+
+def test_admission_full_rejects_resource_exhausted_retryable():
+    q = dispatch.AdmissionQueues(env={ENV_QUEUE_DEPTH_CONTROL: "2"})
+    c1 = q.enter("GetTask")
+    c2 = q.enter("GetTask")
+    assert c1 == c2 == dispatch.CLASS_CONTROL
+    with pytest.raises(PolicyRpcError) as ei:
+        q.enter("GetTask")
+    assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    # the rejection must be retryable under the shared policy schedule:
+    # clients back off deterministically instead of stacking threads
+    assert grpc.StatusCode.RESOURCE_EXHAUSTED in RETRYABLE_CODES
+    q.leave(c1)
+    assert q.enter("GetTask") == dispatch.CLASS_CONTROL  # slot freed
+
+
+def test_admission_classes_are_independent():
+    q = dispatch.AdmissionQueues(
+        env={ENV_QUEUE_DEPTH_CONTROL: "1", ENV_QUEUE_DEPTH_REPORT: "1"}
+    )
+    q.enter("GetTask")
+    # a full control queue must not shed report-class fan-in traffic
+    cls = q.enter("PSPushDelta")
+    assert cls == dispatch.CLASS_REPORT
+    with pytest.raises(PolicyRpcError):
+        q.enter("ReportGradient")
+
+
+def test_admission_stats_shape_and_counts():
+    q = dispatch.AdmissionQueues(env={ENV_QUEUE_DEPTH_CONTROL: "1"})
+    q.enter("GetTask")
+    for _ in range(3):
+        with pytest.raises(PolicyRpcError):
+            q.enter("GetTask")
+    stats = q.stats()
+    assert set(stats) == {
+        dispatch.CLASS_REPORT, dispatch.CLASS_PULL, dispatch.CLASS_CONTROL
+    }
+    ctrl = stats[dispatch.CLASS_CONTROL]
+    assert ctrl == {"depth": 1, "inflight": 1, "rejected": 3}
+    assert stats[dispatch.CLASS_REPORT]["depth"] == 1024  # default
+
+
+def test_admission_bad_env_falls_back_to_default():
+    q = dispatch.AdmissionQueues(env={ENV_QUEUE_DEPTH_REPORT: "many"})
+    assert q.depth(dispatch.CLASS_REPORT) == 1024
+    q2 = dispatch.AdmissionQueues(env={ENV_QUEUE_DEPTH_REPORT: "-5"})
+    assert q2.depth(dispatch.CLASS_REPORT) == 1  # clamped, never 0
+
+
+def test_admission_thread_safe_under_contention():
+    """Concurrent enter/leave from many threads never loses a slot:
+    after all threads drain, inflight is exactly zero."""
+    q = dispatch.AdmissionQueues(env={ENV_QUEUE_DEPTH_CONTROL: "8"})
+    rejected = []
+
+    def worker():
+        for _ in range(200):
+            try:
+                cls = q.enter("GetTask")
+            except PolicyRpcError:
+                rejected.append(1)
+            else:
+                q.leave(cls)
+
+    threads = [threading.Thread(target=worker) for _ in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = q.stats()
+    assert stats[dispatch.CLASS_CONTROL]["inflight"] == 0
+    assert stats[dispatch.CLASS_CONTROL]["rejected"] == len(rejected)
+
+
+# -- loop core ----------------------------------------------------------------
+
+
+def test_loop_core_is_process_singleton_and_runs_coroutines():
+    core = dispatch.get_loop_core()
+    assert core is dispatch.get_loop_core()
+    assert not core.on_loop_thread()  # we are a pytest thread
+
+    async def probe():
+        return core.on_loop_thread()
+
+    assert core.submit(probe()).result(timeout=10) is True
+
+
+# -- loop-mode round-trips, tier by tier --------------------------------------
+
+
+def _echo_handlers():
+    def echo(req):
+        return {"x": req.get("x"), "arr": np.arange(4, dtype=np.float32)}
+
+    def boom(req):
+        raise ValueError("kaboom\nwith newline")
+
+    def fenced(req):
+        raise EpochFencedError("ps", 0, 3, int(req.get("epoch", -1)))
+
+    return {"Echo": echo, "Boom": boom, "Fenced": fenced}
+
+
+@pytest.fixture
+def loop_uds_env(monkeypatch, tmp_path):
+    monkeypatch.setenv(ENV_DISPATCH, "loop")
+    monkeypatch.setenv(ENV_TRANSPORT, "uds")
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+
+
+@pytest.fixture
+def loop_inproc_env(monkeypatch):
+    monkeypatch.setenv(ENV_DISPATCH, "loop")
+    monkeypatch.setenv(ENV_TRANSPORT, "inproc")
+
+
+@pytest.mark.parametrize("env_fixture", ["loop_uds_env", "loop_inproc_env"])
+def test_loop_dispatch_roundtrip_and_failure_semantics(env_fixture, request):
+    """EDL_DISPATCH=loop serves the same wire contract as the blocking
+    core on each fast tier: echo round-trip, handler bug -> sanitized
+    INTERNAL, fencing -> FAILED_PRECONDITION."""
+    request.getfixturevalue(env_fixture)
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        resp = client.call("Echo", {"x": 7}, timeout=10)
+        assert resp["x"] == 7
+        np.testing.assert_array_equal(
+            resp["arr"], np.arange(4, dtype=np.float32)
+        )
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("Boom", {}, timeout=10)
+        assert ei.value.code() == grpc.StatusCode.INTERNAL
+        assert "ValueError" in ei.value.details()
+        assert "\n" not in ei.value.details()
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("Fenced", {"epoch": 9}, timeout=10)
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert is_fenced_error(ei.value)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_loop_uds_concurrent_clients(loop_uds_env):
+    """N threads each with their own client hammer one loop-served uds
+    socket; every response routes back to its caller."""
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    errors = []
+
+    def worker(tid):
+        client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+        try:
+            for i in range(20):
+                resp = client.call("Echo", {"x": tid * 1000 + i}, timeout=10)
+                if resp["x"] != tid * 1000 + i:
+                    errors.append((tid, i, resp["x"]))
+        except Exception as e:  # pragma: no cover - assertion surface
+            errors.append((tid, repr(e)))
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    assert errors == []
+
+
+def test_loop_uds_server_close_severs_connections(loop_uds_env):
+    """A stopped loop-mode server refuses pooled clients exactly like a
+    stopped gRPC server: UNAVAILABLE (retryable), not a hang."""
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(
+        f"localhost:{server.port}", policy=fast_policy(max_attempts=2)
+    )
+    try:
+        assert client.call("Echo", {"x": 1}, timeout=10)["x"] == 1
+        server.stop()
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("Echo", {"x": 2}, timeout=2)
+        assert ei.value.code() in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_loop_dispatcher_reports_admission_stats(loop_inproc_env):
+    from elasticdl_tpu.rpc import transport
+    from elasticdl_tpu.rpc.policy import WireStats
+
+    disp = transport.ServerDispatcher(_echo_handlers(), WireStats("t"))
+    try:
+        assert disp.mode == dispatch.DISPATCH_LOOP
+        from elasticdl_tpu.common import messages
+
+        disp.dispatch(
+            "Echo", messages.pack({"x": 1}), transport.TRANSPORT_INPROC
+        )
+        stats = disp.admission_stats()
+        assert stats is not None
+        # the echo has left the queue by the time we look
+        assert stats[dispatch.CLASS_CONTROL]["inflight"] == 0
+    finally:
+        disp.close()
+
+
+def test_threads_dispatcher_has_no_admission_stats(monkeypatch):
+    from elasticdl_tpu.rpc import transport
+    from elasticdl_tpu.rpc.policy import WireStats
+
+    monkeypatch.delenv(ENV_DISPATCH, raising=False)
+    disp = transport.ServerDispatcher(_echo_handlers(), WireStats("t"))
+    assert disp.mode == dispatch.DISPATCH_THREADS
+    assert disp.admission_stats() is None
+    disp.close()

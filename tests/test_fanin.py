@@ -1,0 +1,438 @@
+"""Hierarchical fan-in combine stage (master/fanin.py) unit tests:
+presum exactness over dense/sparse/quantized members, CombineBuffer
+batch formation and per-member answer routing, and the PS-shard batch
+appliers — the combined fast path must be indistinguishable from the
+serial interleaving (versions, dedup, merged slices), with every
+anomaly falling back to member-by-member serial semantics under the
+same single lock acquisition."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import codec, messages
+from elasticdl_tpu.common.constants import (
+    ENV_FANIN_BATCH,
+    ENV_FANIN_COMBINE,
+    ENV_FANIN_WAIT_MS,
+)
+from elasticdl_tpu.master import fanin
+from elasticdl_tpu.master.fanin import CombineBuffer, Member, presum_f32
+from elasticdl_tpu.master.ps_shard import PSShardServicer
+
+# exactly representable in f32 at any summation order: bit-identical
+# results regardless of batching (same trick as the chaos e2e)
+DELTA = 2.0 ** -12
+
+
+# -- presum_f32 ---------------------------------------------------------------
+
+
+def test_presum_dense_matches_serial_bitwise():
+    rng = np.random.default_rng(7)
+    # exactly-representable members: serial += and blocked presum must
+    # agree bit for bit
+    members = [
+        (rng.integers(-64, 64, size=200_000) * DELTA).astype(np.float32)
+        for _ in range(5)
+    ]
+    originals = [m.copy() for m in members]
+    serial = members[0].copy()
+    for m in members[1:]:
+        serial += m
+    acc = presum_f32(members)
+    assert acc.dtype == np.float32
+    np.testing.assert_array_equal(acc, serial)
+    # fresh writable accumulator: inputs untouched
+    acc += 1.0
+    for m, orig in zip(members, originals):
+        np.testing.assert_array_equal(m, orig)
+
+
+def test_presum_spans_cache_blocks():
+    n = fanin._PRESUM_BLOCK * 2 + 17  # exercise the ragged tail block
+    a = np.full(n, DELTA, np.float32)
+    b = np.full(n, 2 * DELTA, np.float32)
+    np.testing.assert_array_equal(
+        presum_f32([a, b]), np.full(n, 3 * DELTA, np.float32)
+    )
+
+
+def _sparse(n, idx, vals):
+    return codec.SparseDelta(
+        indices=np.asarray(idx, np.int64),
+        values=np.asarray(vals, np.float32),
+        n=n,
+    )
+
+
+def test_presum_all_sparse_scatter_adds():
+    s1 = _sparse(10, [1, 4], [DELTA, DELTA])
+    s2 = _sparse(10, [4, 9], [DELTA, 2 * DELTA])
+    acc = presum_f32([s1, s2], n=10)
+    expected = np.zeros(10, np.float32)
+    expected[1] = DELTA
+    expected[4] = 2 * DELTA
+    expected[9] = 2 * DELTA
+    np.testing.assert_array_equal(acc, expected)
+
+
+def test_presum_mixed_dense_and_sparse():
+    dense = np.full(10, DELTA, np.float32)
+    s = _sparse(10, [0, 5], [DELTA, DELTA])
+    acc = presum_f32([dense, s])
+    expected = dense + s.dense()
+    np.testing.assert_array_equal(acc, expected)
+
+
+def test_presum_topk_int8_members_dequantize():
+    vals = np.array([0.5, -0.25, 0.125], np.float32)
+    q = codec.quantize_int8(vals)
+    s = codec.SparseDelta(
+        indices=np.array([2, 7, 11], np.int64), values=q, n=16
+    )
+    acc = presum_f32([s, s], n=16)
+    np.testing.assert_array_equal(acc, s.dense() + s.dense())
+
+
+# -- CombineBuffer ------------------------------------------------------------
+
+
+def test_combine_buffer_forms_batches_under_concurrency():
+    """K members submitted concurrently for one lineage key arrive at
+    apply_batch in (few) batches, each answered individually."""
+    batches = []
+
+    def apply_batch(members):
+        batches.append(len(members))
+        for i, m in enumerate(members):
+            m.resp = {"rank": m.req["i"]}
+
+    buf = CombineBuffer(apply_batch, max_batch=32, max_wait_s=0.05)
+    results = {}
+    lock = threading.Lock()
+
+    def pusher(i):
+        resp = buf.submit(("delta", "f32"), {"i": i}, np.zeros(4, np.float32))
+        with lock:
+            results[i] = resp
+
+    threads = [threading.Thread(target=pusher, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    buf.close()
+    assert sum(batches) == 16  # nothing lost, nothing duplicated
+    assert all(results[i] == {"rank": i} for i in range(16))
+    # the linger window lets the cohort coalesce: fewer batches than
+    # members (on 1 CPU run-until-block usually one or two batches)
+    assert len(batches) < 16
+
+
+def test_combine_buffer_respects_max_batch():
+    sizes = []
+
+    def apply_batch(members):
+        sizes.append(len(members))
+        for m in members:
+            m.resp = {}
+
+    buf = CombineBuffer(apply_batch, max_batch=4, max_wait_s=0.05)
+    threads = [
+        threading.Thread(
+            target=buf.submit, args=(("k",), {"i": i}, None)
+        )
+        for i in range(10)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    buf.close()
+    assert sum(sizes) == 10
+    assert max(sizes) <= 4
+
+
+def test_combine_buffer_keys_never_mix():
+    seen = []
+
+    def apply_batch(members):
+        keys = {m.req["key"] for m in members}
+        seen.append(keys)
+        for m in members:
+            m.resp = {}
+
+    buf = CombineBuffer(apply_batch, max_batch=32, max_wait_s=0.05)
+    threads = [
+        threading.Thread(
+            target=buf.submit,
+            args=(("delta", "f32" if i % 2 else "bf16"),
+                  {"key": "f32" if i % 2 else "bf16"}, None),
+        )
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    buf.close()
+    # every drained batch holds exactly one lineage
+    assert all(len(keys) == 1 for keys in seen)
+
+
+def test_combine_buffer_error_propagates_to_every_member():
+    def apply_batch(members):
+        raise ValueError("shard wedged")
+
+    buf = CombineBuffer(apply_batch, max_batch=8)
+    with pytest.raises(ValueError, match="shard wedged"):
+        buf.submit(("k",), {}, None)
+    buf.close()
+
+
+def test_combine_buffer_partial_errors_stay_per_member():
+    def apply_batch(members):
+        for i, m in enumerate(members):
+            if m.req["i"] == 0:
+                m.error = ValueError("bad member")
+            else:
+                m.resp = {"ok": True}
+
+    buf = CombineBuffer(apply_batch, max_batch=8)
+    with pytest.raises(ValueError, match="bad member"):
+        buf.submit(("k",), {"i": 0}, None)
+    assert buf.submit(("k",), {"i": 1}, None) == {"ok": True}
+    buf.close()
+
+
+def test_combine_buffer_closed_rejects_submit():
+    buf = CombineBuffer(lambda members: None)
+    buf.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        buf.submit(("k",), {}, None)
+
+
+def test_combine_env_knobs():
+    assert fanin.combine_enabled({ENV_FANIN_COMBINE: "1"})
+    assert fanin.combine_enabled({ENV_FANIN_COMBINE: "true"})
+    assert not fanin.combine_enabled({ENV_FANIN_COMBINE: "0"})
+    assert not fanin.combine_enabled({})
+    assert fanin.combine_batch({ENV_FANIN_BATCH: "8"}) == 8
+    assert fanin.combine_batch({ENV_FANIN_BATCH: "junk"}) == 32
+    assert fanin.combine_batch({ENV_FANIN_BATCH: "0"}) == 1
+    assert fanin.combine_wait_s({ENV_FANIN_WAIT_MS: "5"}) == 0.005
+    assert fanin.combine_wait_s({}) == 0.0
+
+
+# -- PS-shard batch appliers --------------------------------------------------
+
+
+def _shard(**kw):
+    kw.setdefault("fanin_combine", True)
+    shard = PSShardServicer(0, 1, **kw)
+    shard.init_slice(
+        {"vec": np.zeros(64, np.float32), "version": 0}
+    )
+    return shard
+
+
+def _member(i, steps=1, base=0, n=64, key=None):
+    req = {
+        "steps": steps,
+        "base_version": base,
+        "report_key": key or f"w{i}:s{i}",
+    }
+    delta = np.full(n, DELTA * (i + 1), np.float32)
+    return Member(dict(req, delta=delta), delta)
+
+
+def test_apply_delta_batch_matches_serial_exactly():
+    combined = _shard()
+    serial = _shard(fanin_combine=False)
+    members = [_member(i) for i in range(6)]
+    combined._apply_delta_batch(members)
+    for i in range(6):
+        serial.push_delta(
+            {
+                "delta": np.full(64, DELTA * (i + 1), np.float32),
+                "steps": 1,
+                "base_version": 0,
+                "report_key": f"w{i}:s{i}",
+            }
+        )
+    got = combined.pull({})
+    want = serial.pull({})
+    assert got["version"] == want["version"] == 6
+    np.testing.assert_array_equal(got["vec"], want["vec"])
+    # fast path: every member shares ONE pre-packed response object
+    packed = {id(m.resp) for m in members}
+    assert len(packed) == 1
+    resp = messages.unpack(messages.pack(members[0].resp))
+    assert resp["version"] == 6
+    np.testing.assert_array_equal(resp["vec"], want["vec"])
+    stats = combined.stats()
+    assert stats["combined_batches"] == 1
+    assert stats["combined_reports"] == 6
+
+
+def test_apply_delta_batch_replay_falls_back_and_dedups():
+    """A batch holding a replayed report_key takes the serial fallback
+    under the same single acquisition: the replay no-ops (dedup), the
+    fresh members apply exactly once."""
+    shard = _shard()
+    # first apply registers the key
+    shard._apply_delta_batch([_member(0)])
+    v1 = shard.pull({})["version"]
+    replay = _member(0)  # same report_key -> duplicate
+    fresh = _member(1)
+    shard._apply_delta_batch([replay, fresh])
+    resp_replay = replay.resp
+    assert not isinstance(resp_replay, messages.Prepacked)  # serial path
+    assert resp_replay["duplicate"] is True
+    assert shard.pull({})["version"] == v1 + 1  # only the fresh step
+    expected = np.full(64, DELTA, np.float32) * 1 + np.full(
+        64, DELTA * 2, np.float32
+    )
+    np.testing.assert_array_equal(shard.pull({})["vec"], expected)
+
+
+def test_apply_delta_batch_intra_batch_replay_dedups():
+    """A replay can share a batch with its ORIGINAL (client timed out
+    while the original was still parked in the buffer): the fast path
+    must fall back so the second occurrence no-ops instead of
+    double-applying."""
+    shard = _shard()
+    original = _member(0)
+    replay = _member(0)  # same report_key, in the SAME batch
+    other = _member(1)
+    shard._apply_delta_batch([original, replay, other])
+    assert shard.pull({})["version"] == 2  # original + other, once each
+    expected = np.full(64, DELTA, np.float32) + np.full(
+        64, 2 * DELTA, np.float32
+    )
+    np.testing.assert_array_equal(shard.pull({})["vec"], expected)
+    resps = [original.resp, replay.resp]
+    assert sum(1 for r in resps if r.get("duplicate")) == 1
+
+
+def test_apply_grad_batch_intra_batch_replay_dedups():
+    shard = _shard(grads_to_wait=100)
+    g = np.full(64, DELTA, np.float32)
+    original = Member({"report_key": "g0", "version": 0}, g)
+    replay = Member({"report_key": "g0", "version": 0}, g)
+    shard._apply_grad_batch([original, replay])
+    assert shard._grad_n == 1  # applied exactly once
+    np.testing.assert_array_equal(shard._grad_sum, g)
+
+
+def test_apply_delta_batch_shape_mismatch_isolated_to_member():
+    shard = _shard()
+    good = _member(0)
+    bad = Member(
+        {"steps": 1, "base_version": 0, "report_key": "bad:1"},
+        np.ones(7, np.float32),  # wrong slice length
+    )
+    shard._apply_delta_batch([good, bad])
+    assert good.error is None and good.resp is not None
+    assert isinstance(bad.error, ValueError)
+    assert shard.pull({})["version"] == 1  # only the good member landed
+
+
+def test_apply_delta_batch_sparse_members_exact():
+    shard = _shard()
+    serial = _shard(fanin_combine=False)
+    sparse_members = []
+    for i in range(4):
+        idx = np.array([i, 16 + i, 32 + i], np.int64)
+        vals = np.full(3, DELTA * (i + 1), np.float32)
+        sd = codec.SparseDelta(indices=idx, values=vals, n=64)
+        sparse_members.append(
+            Member(
+                {"steps": 1, "base_version": 0, "report_key": f"s{i}"},
+                sd,
+            )
+        )
+        serial.push_delta(
+            {
+                "delta": sd,
+                "steps": 1,
+                "base_version": 0,
+                "report_key": f"s{i}",
+            }
+        )
+    shard._apply_delta_batch(sparse_members)
+    np.testing.assert_array_equal(
+        shard.pull({})["vec"], serial.pull({})["vec"]
+    )
+    assert shard.pull({})["version"] == serial.pull({})["version"]
+
+
+def test_apply_grad_batch_pure_accumulate_matches_serial():
+    combined = _shard(grads_to_wait=100)
+    serial = _shard(grads_to_wait=100, fanin_combine=False)
+    members = []
+    for i in range(5):
+        g = np.full(64, DELTA * (i + 1), np.float32)
+        members.append(Member({"report_key": f"g{i}", "version": 0}, g))
+        serial.push_grad(
+            {"grad": g, "report_key": f"g{i}", "version": 0}
+        )
+    combined._apply_grad_batch(members)
+    assert all(m.resp == {"accepted": True, "version": 0} for m in members)
+    np.testing.assert_array_equal(combined._grad_sum, serial._grad_sum)
+    assert combined._grad_n == serial._grad_n == 5
+
+
+def test_push_delta_end_to_end_through_combine_buffer():
+    """The public push_delta surface with combining on: concurrent
+    pushers end at the same model state as the serial shard, and the
+    combine counters show batches actually formed."""
+    combined = _shard()
+    serial = _shard(fanin_combine=False)
+    n_workers = 12
+    errors = []
+
+    def pusher(i):
+        try:
+            resp = combined.push_delta(
+                {
+                    "delta": np.full(64, DELTA, np.float32),
+                    "steps": 1,
+                    "base_version": 0,
+                    "report_key": f"p{i}",
+                }
+            )
+            if isinstance(resp, messages.Prepacked):
+                # the RPC layer passes prepacked bytes through; direct
+                # callers decode to see the member's answer
+                resp = messages.unpack(messages.pack(resp))
+            assert resp["version"] >= 1
+        except Exception as e:  # pragma: no cover - assertion surface
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=pusher, args=(i,)) for i in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(n_workers):
+        serial.push_delta(
+            {
+                "delta": np.full(64, DELTA, np.float32),
+                "steps": 1,
+                "base_version": 0,
+                "report_key": f"p{i}",
+            }
+        )
+    assert errors == []
+    np.testing.assert_array_equal(
+        combined.pull({})["vec"], serial.pull({})["vec"]
+    )
+    assert combined.pull({})["version"] == n_workers
+    stats = combined.stats()
+    assert stats["combined_reports"] == n_workers
+    assert stats["combined_batches"] <= n_workers

@@ -1,0 +1,81 @@
+"""Fan-in bench smoke/stress (bench_fanin.py) — out of the tier-1
+gate (e2e-marked; CI runs them as a dedicated job). The smoke tier
+(perf) proves the harness end to end at N=8: both cores complete
+reports, accounting is exact (version == applied pushes), the combine
+stage actually batches, and the suite JSON carries the headline
+contract bench.py embeds. The stress tier (slow) drives N=64 through
+the loop+combine core and holds the exactness bar under real
+contention."""
+
+import pytest
+
+from bench_fanin import DEFAULT_SLICE, run_cell, run_suite
+
+# short windows: these are harness/contract checks, not measurements —
+# the real numbers come from bench.py's JSON (docs/performance.md)
+WARMUP_S = 0.2
+WINDOW_S = 0.6
+
+
+@pytest.mark.e2e
+@pytest.mark.perf
+def test_fanin_smoke_n8_both_cores_exact():
+    n = 8
+    blocking = run_cell(
+        n, "inproc", dispatch="threads", combine=False, wire="topk",
+        warmup_s=WARMUP_S, window_s=WINDOW_S,
+    )
+    combined = run_cell(
+        n, "inproc", dispatch="loop", combine=True, wire="topk",
+        warmup_s=WARMUP_S, window_s=WINDOW_S,
+    )
+    for cell in (blocking, combined):
+        assert cell["reports_per_sec"] > 0
+        # exactness rides every cell: steps=1 pushes, so the final
+        # version must equal the number of applied pushes — nothing
+        # lost, nothing double-applied
+        assert cell["version"] == cell["applied_pushes"] > 0
+    assert blocking["core"] == "blocking"
+    assert combined["core"] == "loop_combine"
+    # the combine stage actually formed batches (ratio > 1 means at
+    # least one multi-member batch; 1.0 would be serial-in-disguise)
+    assert combined["combine_ratio"] > 1.0
+
+
+@pytest.mark.e2e
+@pytest.mark.perf
+def test_fanin_smoke_suite_json_contract():
+    """The suite shape bench.py embeds under its "fanin" key: cells
+    indexed [tier][wire][N], speedups at max N, and a headline value."""
+    suite = run_suite(
+        ns=(8,),
+        grid=(("inproc", ("topk",)),),
+        warmup_s=WARMUP_S,
+        window_s=WINDOW_S,
+    )
+    cell = suite["cells"]["inproc"]["topk"]["8"]
+    assert cell["blocking"]["reports_per_sec"] > 0
+    assert cell["loop_combine"]["reports_per_sec"] > 0
+    assert cell["speedup"] > 0
+    key = "inproc/topk"
+    assert key in suite["speedup_at_max_n"]
+    assert suite["speedup_at_max_n"][key] > 0
+    assert suite["headline_cell"] == key
+    assert suite["value"] == suite["speedup_at_max_n"][key]
+    assert "protocol" in suite
+
+
+@pytest.mark.e2e
+@pytest.mark.slow
+def test_fanin_stress_n64_loop_combine_exact():
+    """N=64 closed-loop pushers through the loop core with combining:
+    the contended regime the 4x acceptance runs at (N=256) in miniature,
+    with the exactness bar held under real contention."""
+    cell = run_cell(
+        64, "inproc", dispatch="loop", combine=True, wire="topk",
+        slice_len=DEFAULT_SLICE, warmup_s=0.3, window_s=1.5,
+    )
+    assert cell["reports_per_sec"] > 0
+    assert cell["version"] == cell["applied_pushes"] > 0
+    # at 64 concurrent pushers batches must be deep, not pairs
+    assert cell["combine_ratio"] > 2.0

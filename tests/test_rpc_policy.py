@@ -318,3 +318,124 @@ def test_server_abort_carries_sanitized_detail():
         client.close()
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# WireStats striping: exact totals under contention, unchanged shape
+# ---------------------------------------------------------------------------
+
+
+def test_wire_stats_striped_totals_exact_under_contention():
+    """N threads hammer record() concurrently; the merged snapshot must
+    equal the arithmetic sum exactly — striping trades contention for a
+    merge at snapshot time, never for accuracy."""
+    from elasticdl_tpu.rpc.policy import WireStats
+
+    ws = WireStats("test:0")
+    n_threads, n_iters = 16, 400
+    start = threading.Barrier(n_threads)
+
+    def hammer(tid):
+        start.wait()
+        for i in range(n_iters):
+            ws.record(
+                "Report" if i % 2 else "Pull",
+                sent=tid + 1,
+                received=2 * (tid + 1),
+                transport="uds" if i % 3 else "inproc",
+            )
+
+    threads = [
+        threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    snap = ws.snapshot()
+    total_sent = n_iters * sum(t + 1 for t in range(n_threads))
+    assert snap["bytes_sent"] == total_sent
+    assert snap["bytes_received"] == 2 * total_sent
+    assert snap["calls"] == n_threads * n_iters
+    # per-method split: even i -> Pull, odd i -> Report, 200 each
+    per_method_sent = total_sent // 2
+    for m in ("Report", "Pull"):
+        assert snap["methods"][m]["bytes_sent"] == per_method_sent
+        assert snap["methods"][m]["calls"] == n_threads * n_iters // 2
+    # transport dimension sums to the same totals
+    assert (
+        sum(v["bytes_sent"] for v in snap["transports"].values())
+        == total_sent
+    )
+    assert set(snap["transports"]) == {"uds", "inproc"}
+
+
+def test_wire_stats_threads_spread_across_stripes():
+    """Round-robin pinning: distinct threads land on distinct stripes
+    (until the stripe count wraps), so concurrent recorders don't
+    convoy on one lock."""
+    from elasticdl_tpu.rpc.policy import WireStats, _stripe_index
+
+    seen = []
+    seen_lock = threading.Lock()
+
+    def probe():
+        idx = _stripe_index()
+        with seen_lock:
+            seen.append(idx)
+
+    threads = [threading.Thread(target=probe) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(0 <= i < WireStats._NUM_STRIPES for i in seen)
+    # 8 fresh threads over 8 stripes: more than one stripe must be hit
+    # (exact assignment depends on prior pinning in this process)
+    assert len(set(seen)) > 1
+
+
+def test_wire_stats_snapshot_shape_and_reset():
+    """The striped snapshot keeps the pre-striping contract: same keys,
+    plain dicts; reset() clears every stripe."""
+    from elasticdl_tpu.rpc.policy import WireStats
+
+    ws = WireStats("ep:1")
+    ws.record("Push", sent=10, received=4, transport="grpc")
+    ws.record("Push", sent=0, received=0, transport="inproc", calls=1)
+    snap = ws.snapshot()
+    assert set(snap) == {
+        "endpoint", "bytes_sent", "bytes_received", "calls",
+        "methods", "transports",
+    }
+    assert snap["endpoint"] == "ep:1"
+    assert set(snap["methods"]["Push"]) == {
+        "bytes_sent", "bytes_received", "calls"
+    }
+    assert snap["methods"]["Push"]["calls"] == 2  # explicit inproc call
+    assert snap["transports"]["inproc"]["bytes_sent"] == 0
+
+    ws.reset()
+    empty = ws.snapshot()
+    assert empty["bytes_sent"] == 0
+    assert empty["methods"] == {} and empty["transports"] == {}
+
+
+def test_aggregate_wire_snapshots_shape_identical():
+    """aggregate over striped snapshots: same rollup shape and exact
+    sums as the pre-striping implementation."""
+    from elasticdl_tpu.rpc.policy import WireStats, aggregate_wire_snapshots
+
+    a, b = WireStats("a"), WireStats("b")
+    a.record("Report", sent=100, received=8, transport="uds")
+    b.record("Report", sent=50, received=4, transport="uds")
+    b.record("Pull", sent=3, received=900, transport="grpc")
+    agg = aggregate_wire_snapshots([a.snapshot(), b.snapshot()])
+    assert set(agg) == {
+        "bytes_sent", "bytes_received", "methods", "transports"
+    }
+    assert agg["bytes_sent"] == 153
+    assert agg["bytes_received"] == 912
+    assert agg["methods"]["Report"]["bytes_sent"] == 150
+    assert agg["transports"]["uds"]["calls"] == 2

@@ -418,6 +418,82 @@ def test_lock_discipline_suppression_covers_def(tmp_path):
     }
 
 
+LOCK_COND = """
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items = []
+        self._done = 0
+
+    def put(self, x):
+        with self._cond:
+            self._items.append(x)
+            self._cond.notify()
+
+    def take(self):
+        with self._lock:
+            while not self._items:
+                self._cond.wait()
+            return self._items.pop()
+
+    def flush(self):
+        with self._cond:
+            self._cond.wait_for(lambda: self._done >= len(self._items))
+
+    def mark(self):
+        with self._lock:
+            self._done += 1
+"""
+
+
+def test_lock_discipline_condition_aliases_to_wrapped_lock(tmp_path):
+    # with self._cond: IS holding self._lock (Condition(self._lock)),
+    # cond.wait() under the condition releases the lock (not a
+    # blocking-under-lock), and a wait_for predicate lambda runs with
+    # the lock re-acquired — the whole fixture is clean
+    root = _tree(tmp_path, {"mod.py": LOCK_COND})
+    assert run_analysis(root, rules=["lock-discipline"]) == []
+
+
+def test_lock_discipline_bare_condition_guards_itself(tmp_path):
+    # Condition() with no wrapped lock owns its own lock, distinct from
+    # self._lock: flush's predicate now reads _done under the WRONG
+    # guard (mark writes it under _lock), and take waits on a condition
+    # it does NOT hold while holding _lock — both silent in the aliased
+    # original, both real once the condition stops wrapping the lock
+    src = LOCK_COND.replace(
+        "self._cond = threading.Condition(self._lock)",
+        "self._cond = threading.Condition()",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["lock-discipline"])
+    assert any(
+        f.check == "unguarded-access" and "_done" in f.message
+        for f in findings
+    )
+    assert any(f.check == "blocking-under-lock" for f in findings)
+
+
+def test_lock_discipline_foreign_condition_wait_still_blocks(tmp_path):
+    # waiting on someone ELSE's condition while holding your lock is a
+    # real stall — only the held condition's own wait is exempt
+    src = LOCK_GOOD.replace(
+        "    def peek(self):\n        with self._lock:\n"
+        "            return self._n",
+        "    def peek(self, other):\n        with self._lock:\n"
+        "            other.wait()\n            return self._n",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["lock-discipline"]), "lock-discipline"
+    )
+    assert "blocking-under-lock" in checks
+
+
 def test_suppression_requires_reason(tmp_path):
     src = LOCK_BAD.replace(
         "    def peek(self):",
@@ -570,6 +646,8 @@ LOCK_ORDER_GOOD = _fixture("lock_order_good.py")
 LOCK_ORDER_BAD = _fixture("lock_order_bad.py")
 ABORT_GOOD = _fixture("abort_good.py")
 ABORT_BAD = _fixture("abort_bad.py")
+ASYNC_GOOD = _fixture("async_good.py")
+ASYNC_BAD = _fixture("async_bad.py")
 
 
 def test_fencing_flags_unfenced_handler_and_call_site(tmp_path):
@@ -747,6 +825,83 @@ def test_abort_discipline_suppression(tmp_path):
     assert checks == {"fence-swallowed"}  # only the unsuppressed one
 
 
+# -- edl-verify: async-discipline ----------------------------------------------
+
+
+def test_async_discipline_flags_loop_blockers_and_state_leak(tmp_path):
+    root = _tree(tmp_path, {"mod.py": ASYNC_BAD})
+    findings = run_analysis(root, rules=["async-discipline"])
+    checks = _checks(findings, "async-discipline")
+    assert "blocking-on-loop" in checks
+    assert "loop-state-off-loop" in checks
+    msgs = [f.message for f in findings]
+    # the sync RPC two frames below the coroutine, found ACROSS calls
+    assert any(
+        '.call("Ping")' in m and "Listener.serve" in m for m in msgs
+    )
+    assert any("time.sleep" in m for m in msgs)  # direct coroutine sleep
+    assert any(".acquire()" in m for m in msgs)  # unbounded lock park
+    assert any("_writers" in m and "reset" in m for m in msgs)
+
+
+def test_async_discipline_clean_under_all_rules(tmp_path):
+    # awaited async APIs, the run_in_executor reference boundary,
+    # bounded acquire, on-loop-only touches: silent under every family
+    root = _tree(tmp_path, {"mod.py": ASYNC_GOOD})
+    assert run_analysis(root) == []
+
+
+def test_async_discipline_executor_reference_is_a_boundary(tmp_path):
+    # calling the blocking half DIRECTLY (instead of passing it to
+    # run_in_executor as a reference) puts it on the loop: must flag
+    src = ASYNC_GOOD.replace(
+        "return await self._loop.run_in_executor(\n"
+        "            self._executor, _blocking_half, client\n"
+        "        )",
+        "return _blocking_half(client)",
+    )
+    assert "_blocking_half(client)" in src  # replacement applied
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["async-discipline"]), "async-discipline"
+    )
+    assert "blocking-on-loop" in checks
+
+
+def test_async_discipline_init_exempt_from_loop_state(tmp_path):
+    # __init__ constructs the loop-confined state before the loop can
+    # see the object; only post-construction sync methods are flagged
+    findings = run_analysis(
+        _tree(tmp_path, {"mod.py": ASYNC_BAD}), rules=["async-discipline"]
+    )
+    assert not any(
+        f.check == "loop-state-off-loop" and "__init__" in f.message
+        for f in findings
+    )
+
+
+def test_async_discipline_suppression(tmp_path):
+    src = ASYNC_BAD.replace(
+        "    def reset(self):",
+        "    def reset(self):  # edl-lint: disable=async-discipline"
+        " -- quiesced in a test harness, loop already stopped",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["async-discipline"]), "async-discipline"
+    )
+    assert "loop-state-off-loop" not in checks
+    assert "blocking-on-loop" in checks  # the others still fire
+
+
+def test_repo_async_uds_server_declares_loop_state():
+    """The real AsyncUdsServer carries the LOOP_ONLY_ATTRS declaration
+    the rule keys on — the declaration and the rule can't drift apart."""
+    from elasticdl_tpu.rpc.transport import AsyncUdsServer
+
+    assert set(AsyncUdsServer.LOOP_ONLY_ATTRS) == {"_server", "_writers"}
+
+
 # -- edl-verify: the call-graph engine -----------------------------------------
 
 
@@ -830,6 +985,7 @@ def test_cli_rule_selection(tmp_path, rule):
         "fencing-conformance": FENCING_BAD,
         "lock-order": LOCK_ORDER_BAD,
         "abort-discipline": ABORT_BAD,
+        "async-discipline": ASYNC_BAD,
     }
     root = _tree(tmp_path, {"mod.py": sources[rule]})
     assert lint_main(["--root", root, "--rule", rule, "--no-baseline"]) == 1
