@@ -256,7 +256,189 @@ def run_job(
         server.stop()
 
 
+def _boot_sched_job(
+    tmp, tag, n_records, epochs, num_workers, cache_dir, seed, extra=()
+):
+    """Boot one window-mode ProcessBackend job (its own master/server/
+    manager) for the sched contention section. Caller polls and stops."""
+    from elasticdl_tpu.cluster.pod_backend import ProcessBackend
+    from elasticdl_tpu.common.args import (
+        master_parser,
+        resolve_compile_cache_envs,
+        worker_forward_args,
+    )
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.master.worker_manager import WorkerManager
+    from elasticdl_tpu.rpc.server import RpcServer
+
+    data_dir = os.path.join(tmp, f"data-{tag}")
+    os.makedirs(data_dir, exist_ok=True)
+    _write_data(data_dir, n_records, seed=seed)
+    args = master_parser().parse_args(
+        [
+            "--model_zoo", os.path.join(os.path.dirname(__file__), "elasticdl_tpu", "models"),
+            "--model_def", MODEL_DEF,
+            "--minibatch_size", str(MINIBATCH),
+            "--training_data_dir", data_dir,
+            "--records_per_task", str(RECORDS_PER_TASK),
+            "--num_epochs", str(epochs),
+            "--grads_to_wait", "1",
+            "--local_updates", str(LOCAL_UPDATES),
+            "--num_workers", str(num_workers),
+            "--worker_backend", "process",
+            "--compile_cache_dir", cache_dir,
+            *extra,
+        ]
+    )
+    _spec, dispatcher, servicer, _, _ = build_master(args, "training")
+    server = RpcServer(servicer.handlers(), port=0)
+    server.start()
+    backend = ProcessBackend(log_dir=os.path.join(tmp, f"logs-{tag}"))
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=num_workers,
+        worker_argv_fn=lambda wid: worker_forward_args(
+            args, wid, f"localhost:{server.port}"
+        ),
+        envs={"JAX_PLATFORMS": "cpu", **resolve_compile_cache_envs(args)},
+        max_relaunches=2 * num_workers,
+    )
+    return {
+        "tag": tag,
+        "total": n_records * epochs,
+        "dispatcher": dispatcher,
+        "servicer": servicer,
+        "server": server,
+        "backend": backend,
+        "manager": manager,
+        "t0": None,
+        "t_end": None,
+    }
+
+
+def _stop_sched_job(job):
+    job["manager"].stop_relaunch_and_remove_workers()
+    job["backend"].stop()
+    job["server"].stop()
+
+
+def sched_main():
+    """The policy-plane contention bench (EDL_ELASTIC_BENCH_SCHED=1 or
+    --sched): a best-effort job holds a 2-token arbiter fleet; at 25%
+    progress a guaranteed job's capacity request preempts one token —
+    the pod-kill path with a graceful drain — and both jobs run to
+    completion. Prints ONE JSON line with per-job throughput and the
+    preemption / speculative-backup / dedup counters, and hard-fails
+    unless both jobs finish at their exact expected versions."""
+    from elasticdl_tpu.sched import PriorityArbiter
+
+    be_records = int(os.environ.get("EDL_SCHED_BENCH_RECORDS", 2048))
+    g_records = be_records // 2
+    tmp = tempfile.mkdtemp(prefix="edl_sched_bench_")
+    cache = os.path.join(tmp, "xla-cache")
+    arbiter = PriorityArbiter(capacity=2)
+    # speculation on for the best-effort job: after the preemption it
+    # runs degraded, exactly when a straggler clone can win
+    be = _boot_sched_job(
+        tmp, "be", be_records, 1, 2, cache, seed=0,
+        extra=("--qos_class", "best-effort", "--speculate"),
+    )
+    handle_be = arbiter.register(
+        "be", "best-effort", preempt_cb=be["manager"].scale_down
+    )
+    assert arbiter.request(handle_be, 2) == 2
+    be["manager"].start_workers()
+    g = None
+    handle_g = None
+    t_preempt = None
+    jobs = [be]
+    try:
+        deadline = time.time() + 3600.0
+        while any(not j["dispatcher"].finished() for j in jobs):
+            if time.time() > deadline:
+                raise RuntimeError("sched bench did not finish in 3600s")
+            for j in jobs:
+                if j["manager"].all_exited() and not j["dispatcher"].finished():
+                    raise RuntimeError(f"job {j['tag']}: all workers exited")
+                done = j["dispatcher"].completed_records()
+                if j["t0"] is None and done > 0:
+                    j["t0"] = time.time()
+                if j["t_end"] is None and j["dispatcher"].finished():
+                    j["t_end"] = time.time()
+            if (
+                g is None
+                and be["dispatcher"].completed_records() >= be["total"] // 4
+            ):
+                # saturated pool: the guaranteed request preempts one
+                # best-effort worker (SIGTERM -> drain at task boundary)
+                handle_g = arbiter.register("g", "guaranteed")
+                got = arbiter.request(handle_g, 1)
+                assert got == 1, f"guaranteed request got {got} tokens"
+                t_preempt = time.time()
+                g = _boot_sched_job(
+                    tmp, "g", g_records, 1, 1, cache, seed=7,
+                    extra=("--qos_class", "guaranteed"),
+                )
+                g["manager"].start_workers()
+                jobs.append(g)
+                print(
+                    "bench_elastic[sched]: preempted 1 best-effort "
+                    "worker for the guaranteed job",
+                    file=sys.stderr,
+                )
+            time.sleep(0.05)
+        for j in jobs:
+            if j["t_end"] is None:
+                j["t_end"] = time.time()
+            assert not j["dispatcher"].has_failed_tasks(), j["tag"]
+            # the exactness bar: records exactly once, version exactly
+            # execs x window steps — preemption added nothing
+            assert j["dispatcher"].completed_records() == j["total"], j["tag"]
+            expect = j["total"] // MINIBATCH
+            got_v = j["servicer"].version
+            assert got_v == expect, f"{j['tag']}: version {got_v} != {expect}"
+    finally:
+        for j in jobs:
+            _stop_sched_job(j)
+
+    def ips(j):
+        return j["dispatcher"].completed_records() / (j["t_end"] - j["t0"])
+
+    be_stats = be["manager"].snapshot()
+    sched_be = be["dispatcher"].sched_stats()
+    out = {
+        "metric": "sched_two_job_contention_images_per_sec",
+        "value": round(ips(be) + ips(g), 1),
+        "unit": "images_per_sec",
+        "be_images_per_sec": round(ips(be), 1),
+        "g_images_per_sec": round(ips(g), 1),
+        "g_wait_to_first_task_secs": round(g["t0"] - t_preempt, 1),
+        "preemptions": arbiter.stats()["preemptions"],
+        "be_policy_stops": be_stats["policy_stops"],
+        "be_relaunches": be_stats["relaunches"],
+        "be_backups_dispatched": sched_be["backups_dispatched"],
+        "be_backup_wins": sched_be["backup_wins"],
+        "workers": {"be": 2, "g": 1},
+        "records": {"be": be_records, "g": g_records},
+        "protocol": (
+            "two window-mode ProcessBackend jobs over one 2-token "
+            "PriorityArbiter: best-effort holds both tokens; at 25% "
+            "progress a guaranteed request preempts one (SIGTERM, "
+            "task-boundary drain) and the guaranteed job runs on it. "
+            "Both jobs must finish at exact versions; throughput is "
+            "clocked per job from its first completed task"
+        ),
+    }
+    print(json.dumps(out))
+
+
 def main():
+    if (
+        os.environ.get("EDL_ELASTIC_BENCH_SCHED", "") == "1"
+        or "--sched" in sys.argv[1:]
+    ):
+        return sched_main()
     # auto-scale to the host: on a single-core machine the worker
     # processes + master all share one core and the full-size run takes
     # over an hour — half the records and one epoch still cover 8 tasks

@@ -15,6 +15,7 @@ and TensorBoard service (k8s_tensorboard_client.py:9-100):
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from typing import Callable, Dict, List, Optional
 
@@ -404,6 +405,8 @@ class K8sBackend(PodBackend):
         self._envs = envs or {}
         self._cluster_spec = cluster_spec
         self._cb: Optional[Callable[[PodEvent], None]] = None
+        # worker_id -> pod-create time, for policy-kill victim ordering
+        self._started_at: Dict[int, float] = {}
         self._stop = threading.Event()
         self._watcher = threading.Thread(target=self._watch, daemon=True)
         self._watcher.start()
@@ -440,10 +443,22 @@ class K8sBackend(PodBackend):
         )
         pod = apply_cluster_spec(pod, self._cluster_spec)
         self._core.create_namespaced_pod(self._namespace, pod)
+        self._started_at[worker_id] = time.monotonic()
         logger.info("Created worker pod %s", pod["metadata"]["name"])
 
     def delete_worker(self, worker_id: int):
         self._delete_pod(worker_pod_name(self._job_name, worker_id))
+
+    def victim_order(self, worker_ids: List[int]) -> List[int]:
+        """Most recently created pod first: mirrors ProcessBackend —
+        the youngest pod forfeits the least boot/compile investment
+        when a scale-down or QoS preemption kills it."""
+        started = self._started_at
+        return sorted(
+            worker_ids,
+            key=lambda wid: started.get(wid, float("-inf")),
+            reverse=True,
+        )
 
     def _create_shard_pod(
         self, build_fn, shard_id: int, module: str, argv, port: int

@@ -58,6 +58,14 @@ class PodBackend:
     def set_event_callback(self, cb: Callable[[PodEvent], None]):
         raise NotImplementedError
 
+    def victim_order(self, worker_ids: List[int]) -> List[int]:
+        """Order candidates for a policy kill (autoscaler shrink / QoS
+        preemption), most-preferred victim first. Default: youngest id
+        first — the newest worker has the least warm state (compile
+        cache, pulled model, in-flight windows) to throw away, so
+        killing it loses the least invested boot cost."""
+        return sorted(worker_ids, reverse=True)
+
     def stop(self):
         raise NotImplementedError
 
@@ -68,6 +76,7 @@ class _ProcEntry:
     reported: bool = False
     deleted: bool = False
     log_path: str = ""
+    started_at: float = 0.0  # monotonic spawn time (victim ordering)
 
 
 class ProcessBackend(PodBackend):
@@ -145,7 +154,9 @@ class ProcessBackend(PodBackend):
         if stdout is not None:
             stdout.close()  # child holds its own descriptor
         with self._lock:
-            self._procs[worker_id] = _ProcEntry(proc=proc, log_path=log_path)
+            self._procs[worker_id] = _ProcEntry(
+                proc=proc, log_path=log_path, started_at=time.monotonic()
+            )
         logger.info("Started worker %d (pid %d)", worker_id, proc.pid)
         if self._cb:
             self._cb(PodEvent(worker_id, PodPhase.RUNNING))
@@ -164,6 +175,21 @@ class ProcessBackend(PodBackend):
                 entry.proc.kill()
         except ProcessLookupError:  # already gone
             pass
+
+    def victim_order(self, worker_ids: List[int]) -> List[int]:
+        """Prefer the most recently SPAWNED process, not the highest
+        id: relaunches and standby refills can start a lower id after
+        a higher one, and the youngest process is the one with the
+        least jax-import/compile investment to lose."""
+        with self._lock:
+            started = {
+                wid: entry.started_at for wid, entry in self._procs.items()
+            }
+        return sorted(
+            worker_ids,
+            key=lambda wid: started.get(wid, float("-inf")),
+            reverse=True,
+        )
 
     def pid_of(self, worker_id: int) -> Optional[int]:
         with self._lock:

@@ -196,6 +196,40 @@ def add_master_args(parser: argparse.ArgumentParser):
         "worker dies, removing the boot/compile transient from "
         "preemption recovery",
     )
+    # policy plane (elasticdl_tpu/sched/)
+    parser.add_argument(
+        "--qos_class", default="",
+        choices=("", "guaranteed", "burstable", "best-effort"),
+        help="QoS class of this job when it shares a worker fleet "
+        "under a PriorityArbiter: guaranteed jobs may preempt "
+        "best-effort workers to get capacity. Default: EDL_SCHED_QOS "
+        "env, else burstable",
+    )
+    parser.add_argument(
+        "--autoscale", action="store_true",
+        help="utilization-driven worker autoscaling: the master "
+        "aggregates worker phase telemetry and scales the fleet up "
+        "when compute dominates (with pending tasks), down when "
+        "sync_wait dominates — resizes ride the elastic requeue path, "
+        "so exactness is preserved (EDL_SCHED_AUTOSCALE=1 also enables)",
+    )
+    parser.add_argument(
+        "--min_workers", type=pos_int, default=1,
+        help="autoscaler floor: never scale below this many active workers",
+    )
+    parser.add_argument(
+        "--max_workers", type=non_neg_int, default=0,
+        help="autoscaler ceiling (0 = no ceiling)",
+    )
+    parser.add_argument(
+        "--speculate", action="store_true",
+        help="speculative straggler backups: a task whose runtime "
+        "exceeds EDL_SCHED_SPEC_FACTOR x the EDL_SCHED_SPEC_PCTL "
+        "percentile of completed siblings gets a backup copy on an "
+        "idle worker; first report wins, the twin's pushes are "
+        "absorbed by report_key dedup (window mode only; "
+        "EDL_SCHED_SPECULATE=1 also enables)",
+    )
     parser.add_argument("--worker_image", default="")
     parser.add_argument("--namespace", default="default")
     parser.add_argument(

@@ -88,6 +88,16 @@ ENV_TB_BACKEND = "EDL_TPU_TB_BACKEND"
 ENV_NO_NATIVE_KV = "EDL_TPU_NO_NATIVE_KV"
 ENV_TPU_FLASH = "EDL_TPU_FLASH"
 ENV_TPU_TESTS = "EDL_TPU_TESTS"
+ENV_SCHED_QOS = "EDL_SCHED_QOS"
+ENV_SCHED_PHASE_SECS = "EDL_SCHED_PHASE_SECS"
+ENV_SCHED_AUTOSCALE = "EDL_SCHED_AUTOSCALE"
+ENV_SCHED_UP_FRAC = "EDL_SCHED_UP_FRAC"
+ENV_SCHED_DOWN_FRAC = "EDL_SCHED_DOWN_FRAC"
+ENV_SCHED_COOLDOWN_SECS = "EDL_SCHED_COOLDOWN_SECS"
+ENV_SCHED_SPECULATE = "EDL_SCHED_SPECULATE"
+ENV_SCHED_SPEC_FACTOR = "EDL_SCHED_SPEC_FACTOR"
+ENV_SCHED_SPEC_PCTL = "EDL_SCHED_SPEC_PCTL"
+ENV_SCHED_MAX_BACKUPS = "EDL_SCHED_MAX_BACKUPS"
 ENV_K8S_TESTS = "K8S_TESTS"
 ENV_K8S_TEST_IMAGE = "K8S_TEST_IMAGE"
 ENV_K8S_TEST_NAMESPACE = "K8S_TEST_NAMESPACE"
@@ -206,6 +216,52 @@ ENV_REGISTRY = {
         "unset = size heuristic"
     ),
     ENV_TPU_TESTS: "1 enables hardware-gated tests (tests/test_cluster_gated.py)",
+    ENV_SCHED_QOS: (
+        "policy plane: this job's QoS class (guaranteed/burstable/"
+        "best-effort) when sharing a fleet under the priority arbiter; "
+        "--qos_class beats it (default burstable — sched/qos.py)"
+    ),
+    ENV_SCHED_PHASE_SECS: (
+        "policy plane: seconds between worker ReportPhaseStats "
+        "telemetry sends (PhaseTimers snapshots feeding the "
+        "autoscaler; 0 disables; default 2.0)"
+    ),
+    ENV_SCHED_AUTOSCALE: (
+        "1 enables the utilization autoscaler on the master (also "
+        "--autoscale): scale up on compute-bound fleets with queued "
+        "tasks, down when sync_wait dominates (sched/autoscaler.py)"
+    ),
+    ENV_SCHED_UP_FRAC: (
+        "autoscaler: recent fleet compute-fraction at or above which "
+        "a scale-up fires, given headroom and queued work "
+        "(default 0.6)"
+    ),
+    ENV_SCHED_DOWN_FRAC: (
+        "autoscaler: recent fleet sync_wait-fraction at or above "
+        "which a scale-down fires (default 0.5)"
+    ),
+    ENV_SCHED_COOLDOWN_SECS: (
+        "autoscaler: minimum seconds between executed resizes "
+        "(default 5.0)"
+    ),
+    ENV_SCHED_SPECULATE: (
+        "1 enables speculative straggler backups in the task "
+        "dispatcher (also --speculate): a task running past the "
+        "sibling-runtime threshold is re-dispatched to an idle worker, "
+        "first-report-wins via report_key dedup"
+    ),
+    ENV_SCHED_SPEC_FACTOR: (
+        "speculation: multiplier over the completed-sibling runtime "
+        "percentile before a task counts as a straggler (default 1.5)"
+    ),
+    ENV_SCHED_SPEC_PCTL: (
+        "speculation: percentile (0..1) of completed sibling runtimes "
+        "used as the straggler baseline (default 0.5 = median)"
+    ),
+    ENV_SCHED_MAX_BACKUPS: (
+        "speculation: max concurrent backup copies in flight "
+        "(default 2)"
+    ),
     ENV_K8S_TESTS: "1 enables live-cluster tests (tests/test_cluster_gated.py)",
     ENV_K8S_TEST_IMAGE: "worker image for the live-cluster tests",
     ENV_K8S_TEST_NAMESPACE: "namespace for the live-cluster tests",

@@ -48,13 +48,19 @@ class Task:
     end: int = 0
     type: str = TaskType.WAIT
     model_version: int = -1
+    # speculation attempt key: identical for a primary and its backup
+    # copy, fresh per requeue — workers derive per-window report_keys
+    # from it so duplicate pushes from racing copies dedup server-side
+    spec_key: str = ""
+    backup: bool = False  # this copy IS the speculative backup
 
     def to_wire(self) -> dict:
         return dataclasses.asdict(self)
 
     @classmethod
     def from_wire(cls, d: dict) -> "Task":
-        return cls(**d)
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
 
 
 @dataclasses.dataclass
@@ -151,6 +157,7 @@ class ReportLocalUpdateRequest(_WireRequest):
     aux_state: Any = None
     loss: Any = None
     want_model: bool = False
+    report_key: str = ""
     model_dtype: Optional[str] = None
 
 
@@ -177,6 +184,22 @@ class ReportWindowMetaRequest(_WireRequest):
     edl_gradient: Any = None
     loss: Any = None
     want_aux: bool = False
+
+
+@dataclasses.dataclass
+class ReportPhaseStatsRequest(_WireRequest):
+    """Cumulative PhaseTimers snapshot from one worker — the
+    autoscaler's telemetry feed (sched/telemetry.py). Last-write-wins
+    per worker, so resends are harmless."""
+
+    worker_id: int = -1
+    phases: Any = None  # {phase: {"seconds": float, "count": int}}
+
+
+@dataclasses.dataclass
+class GetSchedStatsRequest(_WireRequest):
+    """Policy-plane stats surface: autoscaler/arbiter/speculation
+    counters plus the RPC admission-queue snapshot."""
 
 
 @dataclasses.dataclass
@@ -326,6 +349,8 @@ WIRE_SCHEMAS: Dict[str, type] = {
     "ReportEvaluationMetrics": ReportEvaluationMetricsRequest,
     "ReportTaskResult": ReportTaskResultRequest,
     "ReportWindowMeta": ReportWindowMetaRequest,
+    "ReportPhaseStats": ReportPhaseStatsRequest,
+    "GetSchedStats": GetSchedStatsRequest,
     "EmbeddingLookup": EmbeddingLookupRequest,
     "EmbeddingUpdate": EmbeddingUpdateRequest,
     "PSInit": PSInitRequest,

@@ -102,6 +102,7 @@ class KVShardGroup:
             i, self._n, generation=self.generations[i]
         )
         server = RpcServer(servicer.handlers(), port=0)
+        servicer.attach_admission_stats(server.admission_stats)
         server.start()
         return servicer, server
 

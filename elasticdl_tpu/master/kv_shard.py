@@ -105,6 +105,9 @@ class KVShardServicer:
         # inbound mirrored rows (this shard as someone's replica),
         # keyed by source shard id — never mixed into the primary store
         self._mirror_stores: Dict[int, EmbeddingStore] = {}
+        # hosting RpcServer's admission counters (attached by the
+        # shard host after server construction)
+        self._admission_fn = None
 
     def handlers(self) -> Dict[str, Any]:
         return {
@@ -263,13 +266,23 @@ class KVShardServicer:
             self._mirror_q.put(_STOP)
             thread.join(timeout=5.0)
 
+    def attach_admission_stats(self, fn):
+        """Point stats() at the hosting RpcServer's admission counters
+        (RpcServer.admission_stats)."""
+        self._admission_fn = fn
+
     def stats(self) -> Dict[str, int]:
         with self._mirror_lock:
             mirror_sources = len(self._mirror_stores)
-        return {
+        out = {
             "n": len(self._store),
             "generation": self.generation,
             "mirrored_writes": self._mirrored_writes,
             "mirror_drops": self._mirror_drops,
             "mirror_sources": mirror_sources,
         }
+        if self._admission_fn is not None:
+            adm = self._admission_fn()
+            if adm:
+                out["admission"] = adm
+        return out

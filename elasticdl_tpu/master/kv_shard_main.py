@@ -77,6 +77,7 @@ def main(argv=None) -> int:
         args.shard_id, args.num_shards, generation=args.generation
     )
     server = RpcServer(servicer.handlers(), port=args.port)
+    servicer.attach_admission_stats(server.admission_stats)
     server.start()
     logger.info(
         "KV shard %d/%d (generation %d) listening on :%d",

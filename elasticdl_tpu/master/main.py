@@ -246,6 +246,16 @@ def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
             args.checkpoint_filename_for_init,
         )
 
+    from elasticdl_tpu.common.constants import (
+        ENV_SCHED_MAX_BACKUPS,
+        ENV_SCHED_SPEC_FACTOR,
+        ENV_SCHED_SPEC_PCTL,
+        ENV_SCHED_SPECULATE,
+    )
+
+    speculate = bool(getattr(args, "speculate", False)) or os.environ.get(
+        ENV_SCHED_SPECULATE, ""
+    ) in ("1", "true")
     dispatcher = TaskDispatcher(
         training,
         evaluation,
@@ -253,6 +263,15 @@ def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
         args.records_per_task,
         args.num_epochs,
         eval_model_version=init_version,
+        speculate=speculate,
+        spec_percentile=float(os.environ.get(ENV_SCHED_SPEC_PCTL, "") or 0.5),
+        spec_factor=float(os.environ.get(ENV_SCHED_SPEC_FACTOR, "") or 1.5),
+        max_backups=int(os.environ.get(ENV_SCHED_MAX_BACKUPS, "") or 2),
+        # per-step sync grads carry no dedup key, so a backup's pushes
+        # could double-apply — speculation covers training tasks only
+        # in window mode (eval/predict tasks mutate nothing and are
+        # always safe to speculate)
+        speculate_training=args.local_updates > 0,
     )
 
     with_eval = job_type in (
@@ -391,6 +410,11 @@ def main(argv=None) -> int:
     args = master_parser().parse_args(argv)
     try:
         job_type = validate_master_args(args)
+        # fail fast on a bad EDL_SCHED_QOS env (the flag itself is
+        # choice-checked by argparse) before anything is built
+        from elasticdl_tpu.sched import resolve_qos
+
+        qos = resolve_qos(getattr(args, "qos_class", ""))
     except ValueError as e:
         logger.error("invalid arguments: %s", e)
         return 1
@@ -431,6 +455,9 @@ def main(argv=None) -> int:
 
     server = RpcServer(servicer.handlers(), port=args.port)
     server.start()
+    # the master's own RPC admission counters ride GetSchedStats, the
+    # same surface the ps/kv shards expose through their stats() RPC
+    servicer.set_admission_stats_fn(server.admission_stats)
     if args.worker_backend == "k8s":
         # worker pods cannot reach the master via localhost: advertise
         # the pod IP (k8s downward API) or the host's resolvable name
@@ -468,6 +495,51 @@ def main(argv=None) -> int:
             servicer.set_sample_batch_fn(
                 make_sample_batch_fn(args.training_data_dir)
             )
+    # -- policy plane (elasticdl_tpu/sched/) -----------------------------
+    from elasticdl_tpu.common.constants import (
+        ENV_SCHED_AUTOSCALE,
+        ENV_SCHED_COOLDOWN_SECS,
+        ENV_SCHED_DOWN_FRAC,
+        ENV_SCHED_UP_FRAC,
+    )
+    from elasticdl_tpu.sched import PhaseStatsAggregator, UtilizationAutoscaler
+
+    aggregator = PhaseStatsAggregator()
+    servicer.set_phase_stats_sink(aggregator.ingest)
+    autoscaler = None
+    if getattr(args, "autoscale", False) or os.environ.get(
+        ENV_SCHED_AUTOSCALE, ""
+    ) in ("1", "true"):
+        autoscaler = UtilizationAutoscaler(
+            aggregator,
+            manager,
+            min_workers=args.min_workers,
+            max_workers=args.max_workers,
+            up_threshold=float(os.environ.get(ENV_SCHED_UP_FRAC, "") or 0.6),
+            down_threshold=float(
+                os.environ.get(ENV_SCHED_DOWN_FRAC, "") or 0.5
+            ),
+            cooldown_secs=float(
+                os.environ.get(ENV_SCHED_COOLDOWN_SECS, "") or 5.0
+            ),
+            # scaling up is pointless with an empty todo queue: the new
+            # worker would boot straight into WAIT
+            pending_fn=dispatcher.pending_count,
+        )
+        logger.info(
+            "Autoscaler armed: min=%d max=%d", args.min_workers,
+            args.max_workers,
+        )
+
+    def _sched_stats() -> dict:
+        out = {"qos_class": qos, "workers": manager.snapshot()}
+        out.update(dispatcher.sched_stats())
+        if autoscaler is not None:
+            out["autoscaler"] = autoscaler.stats()
+        out["phases"] = aggregator.snapshot()
+        return out
+
+    servicer.set_sched_stats_fn(_sched_stats)
     ps_dead = threading.Event()
     recovery = None
     if servicer.ps_group is not None or servicer.kv_group is not None:
@@ -492,6 +564,8 @@ def main(argv=None) -> int:
         # fallback when the plane is torn down first (see finally)
         manager.on_ps_failure = lambda sid: ps_dead.set()
     manager.start_workers()
+    if autoscaler is not None:
+        autoscaler.start()
     logger.info("Worker manager status: %s", WorkerManagerStatus.RUNNING)
 
     exit_code = 0
@@ -527,6 +601,8 @@ def main(argv=None) -> int:
             logger.info("Final model saved to %s", args.output)
     finally:
         logger.info("Worker manager status: %s", WorkerManagerStatus.FINISHED)
+        if autoscaler is not None:
+            autoscaler.stop()
         # disarm BEFORE teardown deletes shard pods: their DELETED
         # events are expected here, not a mid-job shard death
         manager.on_shard_failure = None

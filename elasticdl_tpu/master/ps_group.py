@@ -202,6 +202,7 @@ class PSShardGroup:
         )
         server = RpcServer(servicer.handlers(), port=0)
         servicer.attach_wire_stats(server.wire)
+        servicer.attach_admission_stats(server.admission_stats)
         server.start()
         return servicer, server
 

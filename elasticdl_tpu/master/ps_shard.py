@@ -130,6 +130,9 @@ class PSShardServicer:
         # attached by shard_host/ps_group after server construction so
         # `stats()` answers bytes questions over the existing stats RPC
         self._wire = None
+        # RPC admission counters (rpc/transport.ServerDispatcher),
+        # attached the same way — stats() carries both
+        self._admission_fn = None
         # hierarchical fan-in stage (master/fanin.py, --fanin_combine /
         # EDL_FANIN_COMBINE): compatible concurrent pushes are summed
         # OUTSIDE self._lock and applied as one batch — one lock
@@ -506,6 +509,12 @@ class PSShardServicer:
         once right after server construction, before start)."""
         self._wire = wire
 
+    def attach_admission_stats(self, fn):
+        """Point stats() at the hosting RpcServer's admission counters
+        (RpcServer.admission_stats), same contract as
+        attach_wire_stats."""
+        self._admission_fn = fn
+
     def stats(self) -> Dict[str, int]:
         """Push accounting (exactness evidence for the chaos tests):
         `applied_pushes` counts pushes that mutated state,
@@ -529,6 +538,10 @@ class PSShardServicer:
             snap = self._wire.snapshot()
             out["bytes_sent"] = snap["bytes_sent"]
             out["bytes_received"] = snap["bytes_received"]
+        if self._admission_fn is not None:
+            adm = self._admission_fn()
+            if adm:
+                out["admission"] = adm
         return out
 
     def _is_duplicate(self, req: dict) -> bool:  # edl-lint: disable=lock-discipline -- caller holds self._lock

@@ -116,6 +116,7 @@ def main(argv=None) -> int:
     )
     server = RpcServer(servicer.handlers(), port=args.port)
     servicer.attach_wire_stats(server.wire)
+    servicer.attach_admission_stats(server.admission_stats)
     server.start()
     logger.info(
         "PS shard %d/%d (generation %d) listening on :%d",

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 import jax
@@ -139,6 +140,13 @@ class MasterServicer:
         # codec.make_unraveler. Rebuilt lazily if the template ever
         # changes size (checkpoint restore of a different model).
         self._unraveler = None
+        # ReportLocalUpdate dedup ring (mirrors ps_shard's): keyed
+        # window pushes from a speculated task's primary/backup pair —
+        # or a retry resend — are absorbed, never double-applied.
+        # Guarded by self._lock; bounded FIFO eviction.
+        self._seen_local_updates: "OrderedDict[str, bool]" = OrderedDict()
+        self._local_update_dedup_cap = 1024
+        self._duplicate_local_updates = 0
 
     # -- handler table (the 6 reference RPCs + embedding plane) -------------
 
@@ -158,6 +166,8 @@ class MasterServicer:
             "GetAux": self.get_aux,
             "GetSampleBatch": self.get_sample_batch,
             "PSRestoreFromWorker": self.ps_restore_from_worker,
+            "ReportPhaseStats": self.report_phase_stats,
+            "GetSchedStats": self.get_sched_stats,
         }
 
     def set_standby_fn(self, fn):
@@ -175,6 +185,43 @@ class MasterServicer:
         if fn is None:
             return {"records": None}
         return {"records": fn(int(req.get("n", 1)))}
+
+    # -- policy plane (elasticdl_tpu/sched/) --------------------------------
+
+    def set_phase_stats_sink(self, fn):
+        """fn(worker_id, phases); wired to
+        sched.PhaseStatsAggregator.ingest — the autoscaler's telemetry
+        feed. Without a sink, ReportPhaseStats is a no-op ack."""
+        self._phase_stats_sink = fn
+
+    def set_sched_stats_fn(self, fn):
+        """fn() -> dict of policy-plane stats (autoscaler / arbiter /
+        speculation / fleet counters), composed by master main."""
+        self._sched_stats_fn = fn
+
+    def set_admission_stats_fn(self, fn):
+        """fn() -> per-method-class admission-queue snapshot or None;
+        wired to RpcServer.admission_stats."""
+        self._admission_stats_fn = fn
+
+    def report_phase_stats(self, req: dict) -> dict:
+        """Cumulative PhaseTimers snapshot from one worker.
+        Last-write-wins per worker — resends and reordering are
+        harmless, which is what makes this RPC idempotent."""
+        sink = getattr(self, "_phase_stats_sink", None)
+        if sink is not None:
+            sink(int(req.get("worker_id", -1)), req.get("phases"))
+        return {}
+
+    def get_sched_stats(self, req: dict) -> dict:
+        """The policy-plane stats surface (sched.fetch_sched_stats)."""
+        fn = getattr(self, "_sched_stats_fn", None)
+        out = dict(fn() or {}) if fn is not None else {}
+        adm = getattr(self, "_admission_stats_fn", None)
+        out["admission"] = adm() if adm is not None else None
+        with self._lock:
+            out["duplicate_local_updates"] = self._duplicate_local_updates
+        return out
 
     # -- model state --------------------------------------------------------
 
@@ -496,11 +543,24 @@ class MasterServicer:
         steps = int(req["steps"])
         base_version = int(req["base_version"])
         aux_state = req.get("aux_state")
+        report_key = req.get("report_key") or ""
         applied_version = -1
         ckpt_snapshot = None
         with self._lock:
             if self._params is None:
                 raise ValueError("local update reported before model init")
+            if report_key and report_key in self._seen_local_updates:
+                # duplicate: a retry resend, or a speculated task's twin
+                # pushing the same deterministic window key. Absorb it
+                # and hand back the merged model so the absorbed pusher
+                # rebases through the normal merged-back path.
+                self._duplicate_local_updates += 1
+                return {
+                    "version": self._version,
+                    "params_flat": self._flat_model(req.get("model_dtype")),
+                    "aux": jax.tree_util.tree_map(np.copy, self._aux),
+                    "duplicate": True,
+                }
             prev_version = self._version
             # Staleness policy: with `staleness_window > 0`, a delta
             # whose base fell more than the window behind is
@@ -538,6 +598,15 @@ class MasterServicer:
                     jax.tree_util.tree_map(np.copy, self._aux),
                     self._opt_state_snapshot(),
                 )
+            if report_key:
+                # key registered only after the mutation succeeded,
+                # same discipline as ps_shard._record_applied
+                self._seen_local_updates[report_key] = True
+                while (
+                    len(self._seen_local_updates)
+                    > self._local_update_dedup_cap
+                ):
+                    self._seen_local_updates.popitem(last=False)
             resp = {"version": self._version}
             # base fell behind (concurrent syncs): return the merged model
             if base_version + steps != self._version or req.get("want_model"):

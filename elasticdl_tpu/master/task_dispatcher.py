@@ -15,14 +15,22 @@ semantics:
 
 from __future__ import annotations
 
+import dataclasses
 import random
 import threading
-from typing import Dict, Optional, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.common.messages import Task, TaskType
 
 logger = get_logger(__name__)
+
+
+def _percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile of a pre-sorted list (p in 0..1)."""
+    idx = int(round(p * (len(sorted_vals) - 1)))
+    return sorted_vals[min(len(sorted_vals) - 1, max(0, idx))]
 
 
 class TaskDispatcher:
@@ -36,6 +44,13 @@ class TaskDispatcher:
         max_task_retries: int = 10,
         eval_model_version: int = -1,
         shuffle_seed: Optional[int] = None,
+        speculate: bool = False,
+        spec_percentile: float = 0.5,
+        spec_factor: float = 1.5,
+        spec_min_completed: int = 3,
+        max_backups: int = 2,
+        speculate_training: bool = True,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self._lock = threading.Lock()
         # per-dispatcher RNG: a seed pins the epoch shuffle order
@@ -66,6 +81,31 @@ class TaskDispatcher:
         # cumulative records successfully trained (across epochs) —
         # progress/throughput introspection for benches and logs
         self._completed_records = 0
+        # -- speculative straggler backups (elasticdl_tpu/sched/) -----
+        # When a doing-task's runtime exceeds spec_factor x the
+        # spec_percentile of completed same-type runtimes, an idle
+        # worker gets a BACKUP copy carrying the same spec_key; the
+        # copies' window pushes share deterministic report_keys, so
+        # whichever lands second is absorbed by dedup, and the first
+        # task report settles both (first-report-wins).
+        # speculate_training is gated off by main in per-step sync mode
+        # (no report_key dedup covers per-step grads).
+        self._speculate = bool(speculate)
+        self._spec_percentile = float(spec_percentile)
+        self._spec_factor = float(spec_factor)
+        self._spec_min_completed = max(1, int(spec_min_completed))
+        self._max_backups = max(0, int(max_backups))
+        self._speculate_training = bool(speculate_training)
+        self._clock = clock
+        self._attempt_seq = 0
+        self._started: Dict[int, float] = {}  # task_id -> dispatch time
+        self._durations: Dict[str, List[float]] = {}  # type -> runtimes
+        self._backups: Dict[int, int] = {}  # task_id -> backup worker
+        self._backups_dispatched = 0
+        self._backup_wins = 0
+        self._primary_wins = 0
+        self._backup_promotions = 0
+        self._late_reports = 0
 
         if self._training_shards:
             logger.info("Starting epoch %d", self._epoch)
@@ -136,10 +176,61 @@ class TaskDispatcher:
                     logger.info("Starting epoch %d", self._epoch)
                     self._create_training_tasks()
             if not self._todo:
-                return None
+                # idle worker + empty queue: maybe clone a straggler
+                return self._pick_backup_locked(worker_id)
             task = self._todo.pop(0)
+            # fresh attempt key per dispatch (requeues included): the
+            # worker derives window report_keys from it, so only a
+            # PRIMARY/BACKUP PAIR shares keys — a legitimately
+            # re-executed task never collides with its past self
+            self._attempt_seq += 1
+            task.spec_key = f"t{task.task_id}.a{self._attempt_seq}"
+            task.backup = False
             self._doing[task.task_id] = (worker_id, task)
+            self._started[task.task_id] = self._clock()
             return task
+
+    def _pick_backup_locked(self, worker_id: int) -> Optional[Task]:  # edl-lint: disable=lock-discipline -- caller holds self._lock
+        """Speculation: pick the worst straggler among other workers'
+        in-flight tasks and hand `worker_id` a backup copy of it."""
+        if not self._speculate or len(self._backups) >= self._max_backups:
+            return None
+        now = self._clock()
+        best: Optional[Tuple[float, Task]] = None
+        for tid, (owner, task) in self._doing.items():
+            if owner == worker_id or tid in self._backups:
+                continue
+            if task.type == TaskType.TRAINING and not self._speculate_training:
+                continue
+            durations = self._durations.get(task.type)
+            if durations is None or len(durations) < self._spec_min_completed:
+                continue
+            threshold = self._spec_factor * _percentile(
+                sorted(durations), self._spec_percentile
+            )
+            started = self._started.get(tid)
+            if started is None:
+                continue
+            overrun = (now - started) - threshold
+            if overrun <= 0:
+                continue
+            if best is None or overrun > best[0]:
+                best = (overrun, task)
+        if best is None:
+            return None
+        task = best[1]
+        self._backups[task.task_id] = worker_id
+        self._backups_dispatched += 1
+        logger.info(
+            "Speculating: backup of straggler task %d (%.2fs past the "
+            "threshold) dispatched to worker %d",
+            task.task_id,
+            best[0],
+            worker_id,
+        )
+        # a copy, so requeueing the stored primary later never carries
+        # the backup flag
+        return dataclasses.replace(task, backup=True)
 
     def report(
         self, task_id: int, success: bool, worker_id: Optional[int] = None
@@ -147,18 +238,30 @@ class TaskDispatcher:
         """Worker reports task done/failed; failures are requeued
         (reference :153-176). Returns False for unknown ids.
 
-        When `worker_id` is given it must match the doing-map owner:
-        a stale duplicate report (e.g. a worker whose failed-sync path
-        already reported the task, after which another worker claimed
-        the requeued shard) must not pop the new owner's entry."""
+        When `worker_id` is given it must match the doing-map owner —
+        or the task's speculative backup worker: first-report-wins
+        settles a speculated pair, and the loser's late report is
+        absorbed here exactly like a stale duplicate. A stale duplicate
+        report (e.g. a worker whose failed-sync path already reported
+        the task, after which another worker claimed the requeued
+        shard) must not pop the new owner's entry."""
         evaluation_task_completed = None
         with self._lock:
             worker_and_task = self._doing.get(task_id)
             if worker_and_task is None:
+                # the usual benign case: the losing copy of an
+                # already-settled speculated pair reporting late
+                self._late_reports += 1
                 logger.warning("Unknown task completion report: %d", task_id)
                 return False
             owner, task = worker_and_task
-            if worker_id is not None and owner != worker_id:
+            backup_wid = self._backups.get(task_id)
+            from_backup = (
+                worker_id is not None
+                and worker_id == backup_wid
+                and owner != worker_id
+            )
+            if worker_id is not None and owner != worker_id and not from_backup:
                 logger.warning(
                     "Stale report for task %d from worker %d "
                     "(now owned by worker %d); ignoring",
@@ -167,7 +270,34 @@ class TaskDispatcher:
                     owner,
                 )
                 return False
+            if not success and backup_wid is not None and worker_id is not None:
+                # one copy of a speculated pair failed while its twin
+                # still runs: drop only the failed copy — requeueing
+                # here would race a THIRD copy against the live twin
+                del self._backups[task_id]
+                if not from_backup:
+                    self._doing[task_id] = (backup_wid, task)
+                    self._backup_promotions += 1
+                    logger.info(
+                        "Task %d primary failed; backup worker %d "
+                        "promoted to owner",
+                        task_id,
+                        backup_wid,
+                    )
+                return True
             del self._doing[task_id]
+            self._backups.pop(task_id, None)
+            started = self._started.pop(task_id, None)
+            if success:
+                if started is not None:
+                    durations = self._durations.setdefault(task.type, [])
+                    durations.append(self._clock() - started)
+                    if len(durations) > 256:
+                        durations.pop(0)
+                if from_backup:
+                    self._backup_wins += 1
+                elif backup_wid is not None:
+                    self._primary_wins += 1
             if success and task.type == TaskType.TRAINING:
                 self._completed_records += task.end - task.start
             if not success:
@@ -214,10 +344,31 @@ class TaskDispatcher:
         that keeps landing on dying workers must never be classified as
         poison."""
         with self._lock:
+            # the dead worker held BACKUP copies: drop just those —
+            # the primaries are still running
+            for tid in [t for t, w in self._backups.items() if w == worker_id]:
+                del self._backups[tid]
             for tid in [
                 tid for tid, (wid, _) in self._doing.items() if wid == worker_id
             ]:
+                backup_wid = self._backups.pop(tid, None)
+                if backup_wid is not None:
+                    # the straggler died but its speculative twin is
+                    # live: promote the backup instead of racing a
+                    # requeued third copy against it
+                    _, task = self._doing[tid]
+                    self._doing[tid] = (backup_wid, task)
+                    self._backup_promotions += 1
+                    logger.info(
+                        "Task %d owner %d died; backup worker %d "
+                        "promoted to owner",
+                        tid,
+                        worker_id,
+                        backup_wid,
+                    )
+                    continue
                 _, task = self._doing.pop(tid)
+                self._started.pop(tid, None)
                 logger.info("Recovering task %d from dead worker %d", tid, worker_id)
                 self._todo.append(task)
 
@@ -236,6 +387,20 @@ class TaskDispatcher:
             if task_type is None:
                 return len(self._todo)
             return sum(1 for t in self._todo if t.type == task_type)
+
+    def sched_stats(self) -> dict:
+        """Speculation counters for the policy-plane stats surface
+        (GetSchedStats) and the bench JSON."""
+        with self._lock:
+            return {
+                "speculate": self._speculate,
+                "backups_dispatched": self._backups_dispatched,
+                "backups_inflight": len(self._backups),
+                "backup_wins": self._backup_wins,
+                "primary_wins": self._primary_wins,
+                "backup_promotions": self._backup_promotions,
+                "late_reports": self._late_reports,
+            }
 
     def has_failed_tasks(self) -> bool:
         """True when any task was dropped after exhausting its retries —

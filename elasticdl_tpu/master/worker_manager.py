@@ -69,6 +69,15 @@ class WorkerManager:
         self._phases: Dict[int, str] = {}
         self._standby: set = set()  # worker ids held in reserve
         self._live = 0
+        # policy plane (sched/): workers stopped ON PURPOSE by a
+        # scale-down or a QoS preemption. Their terminal event must not
+        # burn the relaunch budget, relaunch a replacement, or promote
+        # a standby — but their in-flight tasks still requeue, which is
+        # exactly what makes a policy resize exactness-preserving.
+        self._policy_stopped: set = set()
+        self._policy_stops = 0
+        self._scale_ups = 0
+        self._scale_downs = 0
         # fired when a PS/KV shard pod dies and no recovery plane is
         # armed (the job must fail fast, not let every worker
         # crash-loop against a dead endpoint)
@@ -122,6 +131,44 @@ class WorkerManager:
         for wid in ids:
             self._backend.delete_worker(wid)
 
+    # -- policy resizes (sched/: autoscaler + arbiter) ----------------------
+
+    def scale_up(self, n: int = 1) -> int:
+        """Start n fresh-id ACTIVE workers (never standbys). Rides the
+        normal start path, so a scaled-up worker is indistinguishable
+        from a boot-time one. Returns the number started."""
+        n = max(0, int(n))
+        for _ in range(n):
+            self._start_one()
+        with self._lock:
+            self._scale_ups += n
+        return n
+
+    def scale_down(self, n: int = 1) -> int:
+        """Stop up to n active workers on purpose (autoscaler shrink or
+        QoS preemption). Victims are marked policy-stopped BEFORE the
+        kill so their terminal event neither relaunches nor burns the
+        budget; their in-flight tasks requeue through the normal
+        recovery path. Standbys are never victims (they hold no tasks
+        and exist to absorb failures). Returns the number stopped."""
+        n = max(0, int(n))
+        with self._lock:
+            candidates = [
+                wid
+                for wid, phase in self._phases.items()
+                if phase in (PodPhase.PENDING, PodPhase.RUNNING)
+                and wid not in self._standby
+                and wid not in self._policy_stopped
+            ]
+            victims = self._backend.victim_order(candidates)[:n]
+            self._policy_stopped.update(victims)
+            self._policy_stops += len(victims)
+            self._scale_downs += len(victims)
+        for wid in victims:
+            logger.info("Policy stop: deleting worker %d", wid)
+            self._backend.delete_worker(wid)
+        return len(victims)
+
     # -- elasticity ---------------------------------------------------------
 
     def _event_cb(self, event: PodEvent):
@@ -157,7 +204,8 @@ class WorkerManager:
         done = event.phase in _TERMINAL
         # "completed with dropped poison tasks": a deliberate terminal
         # state — relaunching would just exit 2 again, churning the
-        # relaunch budget at job end
+        # relaunch budget at job end. A policy stop (scale-down / QoS
+        # preemption) is equally deliberate: no relaunch either.
         completed = event.phase == PodPhase.SUCCEEDED or (
             event.exit_code == EXIT_CODE_JOB_FAILED
         )
@@ -185,6 +233,9 @@ class WorkerManager:
                 self._live = max(0, self._live - 1)
                 dead_standby = event.worker_id in self._standby
                 self._standby.discard(event.worker_id)
+                if event.worker_id in self._policy_stopped:
+                    self._policy_stopped.discard(event.worker_id)
+                    completed = True  # deliberate stop: never relaunch
             recoverable = done and not completed and self._relaunch
             if recoverable and not dead_standby and self._standby:
                 # a warm standby takes over INSTANTLY (no boot/compile
@@ -226,6 +277,36 @@ class WorkerManager:
             )
 
     # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Every introspection counter under ONE lock acquisition — a
+        mutually consistent view. The per-field accessors below each
+        lock separately, so a caller composing them races `_event_cb`
+        between the reads (e.g. live_workers() of the old state with
+        phases() of the new); the autoscaler and the stats surface
+        poll this instead. `active` counts PENDING/RUNNING workers
+        that are neither standby nor being policy-stopped — the
+        resize-decision denominator."""
+        with self._lock:
+            phases = dict(self._phases)
+            active = sum(
+                1
+                for wid, phase in phases.items()
+                if phase in (PodPhase.PENDING, PodPhase.RUNNING)
+                and wid not in self._standby
+                and wid not in self._policy_stopped
+            )
+            return {
+                "live": self._live,
+                "active": active,
+                "phases": phases,
+                "standby": sorted(self._standby),
+                "relaunches": self._relaunches,
+                "promotions": self._promotions,
+                "policy_stops": self._policy_stops,
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+            }
 
     def live_workers(self) -> int:
         with self._lock:

@@ -73,6 +73,14 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
         "GetSampleBatch",
         "ReportTaskResult",
         "EmbeddingLookup",
+        # single-PS window sync: report_key-deduped on the servicer
+        # (MasterServicer.report_local_update absorbs resends with the
+        # current version + model piggyback, mirroring PSPushDelta)
+        "ReportLocalUpdate",
+        # policy plane: phase telemetry is a cumulative last-write-wins
+        # snapshot per worker; sched stats is a pure read
+        "ReportPhaseStats",
+        "GetSchedStats",
         # PS shard plane: reads, SETNX init, report_key-deduped pushes,
         # overwrite-semantics opt restore
         "PSInit",
@@ -110,7 +118,7 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
 #: a keyless push whose first attempt WAS applied would double-apply on
 #: retry.
 DEDUP_KEYED_METHODS: FrozenSet[str] = frozenset(
-    {"PSPushGrad", "PSPushDelta"}
+    {"PSPushGrad", "PSPushDelta", "ReportLocalUpdate"}
 )
 
 

@@ -197,10 +197,18 @@ class ShardedPS:
         base_versions: List[int],
         model_dtype: Optional[str] = None,
         want_model: bool = False,
+        report_key: Optional[str] = None,
     ) -> Tuple[List[int], Dict[int, np.ndarray]]:
         """Window-delta fan-out. Returns (shard_versions,
         {shard_index: merged_slice}) — merged slices only for shards
         whose version ran ahead of base+steps (or on want_model).
+
+        `report_key` pins the dedup key across CALLERS, not just
+        retries: a speculated task's primary and backup derive the
+        same deterministic key for the same window
+        (worker "{spec_key}.w{idx}"), so whichever copy lands second
+        is absorbed by the shard dedup ring instead of double-applied.
+        Default (None) keeps the per-call uuid — retry-safe only.
 
         `delta` may be a dense array or a compressed wire form
         (codec.QuantizedDelta / codec.SparseDelta): `slice_delta`
@@ -213,7 +221,8 @@ class ShardedPS:
         if size != self.n_params:
             raise ValueError(f"delta size {size} != {self.n_params}")
 
-        report_key = uuid.uuid4().hex  # shard-side dedup: retry-safe
+        # shard-side dedup: retry-safe (speculation-safe when pinned)
+        report_key = report_key or uuid.uuid4().hex
 
         def do(c, i):
             s, e = self.bounds[i]

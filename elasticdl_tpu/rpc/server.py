@@ -122,6 +122,14 @@ class RpcServer:
         rpc/policy.WireStats)."""
         return self.wire.snapshot()
 
+    def admission_stats(self):
+        """Per-method-class admission queue depth/inflight/rejections
+        from the loop dispatch core, or None under threads dispatch
+        (rpc/transport.ServerDispatcher.admission_stats). Surfaced in
+        shard `stats()` and the master's GetSchedStats so the
+        autoscaler and operators can see queue pressure."""
+        return self._dispatcher.admission_stats()
+
     def stop(self, grace: float = 0.5):
         transport_mod.unregister_inproc(self.port)
         if self._uds is not None:

@@ -1,0 +1,52 @@
+"""Multi-tenant elasticity policy plane.
+
+Three coordinated engines layered over the existing control plane, none
+of which invents a new failure mode — every action rides a path the
+recovery plane already survives:
+
+- `autoscaler.UtilizationAutoscaler`: consumes worker ``PhaseTimers``
+  summaries (aggregated by `telemetry.PhaseStatsAggregator` from the
+  ReportPhaseStats RPC) and resizes the fleet through
+  ``WorkerManager.scale_up`` / ``scale_down``, so every resize is just
+  a fresh-id start or the pod-kill path elastic requeue covers.
+- `arbiter.PriorityArbiter`: capacity tokens over one shared fleet;
+  a saturated request from a higher-QoS job preempts lower-QoS
+  holders (again the pod-kill path; exact-version resume is the bar).
+- speculative straggler backups live in
+  ``master/task_dispatcher.py`` (dispatch-time policy) with
+  first-report-wins settled by the report_key dedup ring.
+
+QoS classes are defined in `qos` (guaranteed / burstable /
+best-effort, ``--qos_class`` / ``EDL_SCHED_QOS``).
+"""
+
+from elasticdl_tpu.sched.arbiter import JobHandle, PriorityArbiter
+from elasticdl_tpu.sched.autoscaler import UtilizationAutoscaler
+from elasticdl_tpu.sched.qos import (
+    BEST_EFFORT,
+    BURSTABLE,
+    GUARANTEED,
+    QOS_CLASSES,
+    priority_of,
+    resolve_qos,
+)
+from elasticdl_tpu.sched.telemetry import (
+    PhaseStatsAggregator,
+    fetch_sched_stats,
+    merge_phase_snapshots,
+)
+
+__all__ = [
+    "BEST_EFFORT",
+    "BURSTABLE",
+    "GUARANTEED",
+    "QOS_CLASSES",
+    "JobHandle",
+    "PhaseStatsAggregator",
+    "PriorityArbiter",
+    "UtilizationAutoscaler",
+    "fetch_sched_stats",
+    "merge_phase_snapshots",
+    "priority_of",
+    "resolve_qos",
+]

@@ -1,0 +1,159 @@
+"""Priority arbiter: capacity tokens over one shared fleet.
+
+Jobs sharing a PS/KV fleet register with a QoS class and request
+worker-capacity tokens before starting/growing workers. When the pool
+is saturated, a higher-priority request preempts tokens from the
+lowest-priority holders — preemption calls the victim job's
+``preempt_cb`` (normally ``WorkerManager.scale_down``), i.e. exactly
+the pod-kill path the recovery plane survives, so a preempted job
+resumes later with exact versions.
+
+Token accounting is strictly two-phase: victims are selected under the
+pool lock, but the (slow, killing) callbacks run outside it, and only
+the capacity a callback actually reclaimed transfers to the requester.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.sched.qos import priority_of
+
+logger = get_logger(__name__)
+
+
+class JobHandle:
+    """One registered job's view of the pool."""
+
+    def __init__(self, name: str, qos: str, preempt_cb=None):
+        self.name = name
+        self.qos = qos
+        self.priority = priority_of(qos)
+        self.preempt_cb = preempt_cb
+        self.granted = 0  # guarded by the arbiter's lock
+        self.preempted = 0
+
+
+class PriorityArbiter:
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._jobs: List[JobHandle] = []
+        self._grants = 0
+        self._preemptions = 0
+        self._rejections = 0
+
+    # -- registration -------------------------------------------------------
+
+    def register(
+        self,
+        name: str,
+        qos: str,
+        preempt_cb: Optional[Callable[[int], int]] = None,
+    ) -> JobHandle:
+        """`preempt_cb(n)` must release up to n workers and return how
+        many it actually stopped; it must not call back into the
+        arbiter (token bookkeeping here is the caller's)."""
+        handle = JobHandle(name, qos, preempt_cb)
+        with self._lock:
+            self._jobs.append(handle)
+        return handle
+
+    def unregister(self, handle: JobHandle):
+        with self._lock:
+            if handle in self._jobs:
+                self._jobs.remove(handle)
+                handle.granted = 0
+
+    # -- tokens -------------------------------------------------------------
+
+    def request(self, handle: JobHandle, n: int = 1) -> int:
+        """Acquire up to n tokens; preempts lower-QoS holders when the
+        free pool cannot cover the request. Returns the granted count
+        (0..n) — never blocks waiting for capacity."""
+        plan: List[Tuple[JobHandle, int]] = []
+        with self._lock:
+            free = self._capacity - sum(h.granted for h in self._jobs)
+            take = min(n, max(0, free))
+            handle.granted += take
+            need = n - take
+            if need > 0:
+                victims = sorted(
+                    (
+                        h
+                        for h in self._jobs
+                        if h.priority < handle.priority and h.granted > 0
+                    ),
+                    key=lambda h: h.priority,
+                )
+                for victim in victims:
+                    k = min(need, victim.granted)
+                    plan.append((victim, k))
+                    need -= k
+                    if need == 0:
+                        break
+        granted = take
+        for victim, k in plan:
+            reclaimed = k
+            if victim.preempt_cb is not None:
+                try:
+                    reclaimed = int(victim.preempt_cb(k))
+                except Exception:
+                    logger.warning(
+                        "preempt_cb of job %s failed", victim.name, exc_info=True
+                    )
+                    reclaimed = 0
+            with self._lock:
+                reclaimed = max(0, min(reclaimed, victim.granted))
+                victim.granted -= reclaimed
+                victim.preempted += reclaimed
+                handle.granted += reclaimed
+                self._preemptions += reclaimed
+            if reclaimed:
+                logger.info(
+                    "arbiter: preempted %d worker(s) of %s (%s) for %s (%s)",
+                    reclaimed,
+                    victim.name,
+                    victim.qos,
+                    handle.name,
+                    handle.qos,
+                )
+            granted += reclaimed
+        with self._lock:
+            self._grants += granted
+            if granted < n:
+                self._rejections += 1
+        return granted
+
+    def release(self, handle: JobHandle, n: int = 1) -> int:
+        """Return tokens to the pool (job shrank or finished)."""
+        with self._lock:
+            n = max(0, min(int(n), handle.granted))
+            handle.granted -= n
+            return n
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            held = sum(h.granted for h in self._jobs)
+            return {
+                "capacity": self._capacity,
+                "free": self._capacity - held,
+                "grants": self._grants,
+                "preemptions": self._preemptions,
+                "rejections": self._rejections,
+                "jobs": [
+                    {
+                        "name": h.name,
+                        "qos": h.qos,
+                        "granted": h.granted,
+                        "preempted": h.preempted,
+                    }
+                    for h in self._jobs
+                ],
+            }

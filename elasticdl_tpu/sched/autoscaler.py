@@ -1,0 +1,147 @@
+"""Utilization-driven worker autoscaling.
+
+Decision rule (the paper's utilization pitch, reduced to the two
+phases that actually discriminate): scale UP when the fleet spends
+most of its recent time in `compute` (workers are the bottleneck and
+there is queued work to absorb a new one), scale DOWN when `sync_wait`
+dominates (the PS/network is the bottleneck; an extra worker only adds
+contention). Both actions execute through `WorkerManager` — scale-up
+is a fresh-id worker start, scale-down is the policy-kill path whose
+tasks elastic requeue recovers — so the autoscaler cannot violate
+fencing or exactness invariants; it can only trigger paths that
+already preserve them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from elasticdl_tpu.common.log_util import get_logger
+
+logger = get_logger(__name__)
+
+
+class UtilizationAutoscaler:
+    def __init__(
+        self,
+        aggregator,
+        manager,
+        *,
+        min_workers: int = 1,
+        max_workers: int = 0,
+        up_threshold: float = 0.6,
+        down_threshold: float = 0.5,
+        interval_secs: float = 1.0,
+        cooldown_secs: float = 5.0,
+        step: int = 1,
+        pending_fn: Optional[Callable[[], int]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        """`aggregator`: telemetry.PhaseStatsAggregator. `manager`:
+        WorkerManager (needs snapshot/scale_up/scale_down).
+        `pending_fn`: queued-task count — scale-up is pointless (and
+        never fires) without queued work for the new worker."""
+        self._agg = aggregator
+        self._manager = manager
+        self._min = max(0, int(min_workers))
+        self._max = int(max_workers)
+        self._up = float(up_threshold)
+        self._down = float(down_threshold)
+        self._interval = float(interval_secs)
+        self._cooldown = float(cooldown_secs)
+        self._step = max(1, int(step))
+        self._pending_fn = pending_fn
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_resize = float("-inf")
+        self._scale_ups = 0
+        self._scale_downs = 0
+        self._last_decision = "hold"
+        self._last_fractions: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- decision (pure; unit-testable without threads) ---------------------
+
+    def decide(self) -> str:
+        """'up' / 'down' / 'hold' from the current fleet signal.
+        Cooldown is applied by `tick`, not here."""
+        fractions = self._agg.fractions()
+        with self._lock:
+            self._last_fractions = fractions
+        if fractions is None:
+            return "hold"  # not enough signal yet
+        active = self._manager.snapshot()["active"]
+        compute = fractions.get("compute", 0.0)
+        sync_wait = fractions.get("sync_wait", 0.0)
+        if (
+            compute >= self._up
+            and (self._max <= 0 or active < self._max)
+            and (self._pending_fn is None or self._pending_fn() > 0)
+        ):
+            return "up"
+        if sync_wait >= self._down and active > self._min:
+            return "down"
+        return "hold"
+
+    def tick(self) -> str:
+        """One decision + (cooldown-gated) execution. Returns the
+        decision actually executed ('hold' when gated)."""
+        decision = self.decide()
+        now = self._clock()
+        with self._lock:
+            self._last_decision = decision
+            if decision != "hold" and now - self._last_resize < self._cooldown:
+                return "hold"
+            if decision != "hold":
+                self._last_resize = now
+        if decision == "up":
+            n = self._manager.scale_up(self._step)
+            with self._lock:
+                self._scale_ups += n
+            logger.info("autoscaler: scale up +%d (compute-bound fleet)", n)
+        elif decision == "down":
+            n = self._manager.scale_down(self._step)
+            with self._lock:
+                self._scale_downs += n
+            logger.info("autoscaler: scale down -%d (sync_wait-bound fleet)", n)
+        return decision
+
+    # -- background loop ----------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="edl-autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.tick()
+            except Exception:
+                # a scaling hiccup (backend race with a dying pod) must
+                # not kill the policy loop; the next tick re-reads state
+                logger.warning("autoscaler tick failed", exc_info=True)
+
+    def stop(self):
+        self._stop.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+                "last_decision": self._last_decision,
+                "fractions": self._last_fractions,
+                "min_workers": self._min,
+                "max_workers": self._max,
+            }

@@ -1,0 +1,41 @@
+"""QoS classes for jobs sharing one fleet.
+
+Kubernetes' three-tier vocabulary (guaranteed / burstable /
+best-effort), applied to worker capacity instead of pod resources: the
+arbiter preempts strictly lower classes when a saturated fleet must
+admit a higher one, and never preempts within a class.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from elasticdl_tpu.common.constants import ENV_SCHED_QOS
+
+GUARANTEED = "guaranteed"
+BURSTABLE = "burstable"
+BEST_EFFORT = "best-effort"
+
+#: class -> preemption priority; higher preempts lower, ties never
+#: preempt each other
+QOS_CLASSES = {GUARANTEED: 2, BURSTABLE: 1, BEST_EFFORT: 0}
+
+
+def priority_of(qos: str) -> int:
+    return QOS_CLASSES[qos]
+
+
+def resolve_qos(flag_value: str = "", env: Optional[dict] = None) -> str:
+    """Effective QoS class: ``--qos_class`` beats ``EDL_SCHED_QOS``
+    beats the burstable default. Raises on unknown class names so a
+    typo'd job spec fails at submit, not at first preemption."""
+    env = os.environ if env is None else env
+    value = flag_value or env.get(ENV_SCHED_QOS, "") or BURSTABLE
+    value = value.strip().lower()
+    if value not in QOS_CLASSES:
+        raise ValueError(
+            f"unknown QoS class {value!r}; expected one of "
+            f"{sorted(QOS_CLASSES)}"
+        )
+    return value

@@ -115,13 +115,17 @@ def main(argv=None) -> int:
     # whole task loop, viewable in TensorBoard/Perfetto/XProf. The
     # PhaseTimers in the worker cover host-side attribution; this
     # covers the XLA/device side.
-    # Graceful teardown: the master deletes worker pods/processes at
-    # job end (SIGTERM, then SIGKILL after a grace period). Convert
-    # SIGTERM into SystemExit so the finally block below still drains
-    # the final sync and closes the profiler trace.
+    # Graceful teardown: the master deletes worker pods/processes both
+    # at job end and on a policy stop (autoscaler shrink / QoS
+    # preemption), SIGTERM first, SIGKILL after a grace period. Latch a
+    # drain instead of raising: the run loop exits at the next task
+    # boundary with every window synced and every report delivered, so
+    # a preempted worker's tasks are fully settled (nothing requeues,
+    # versions stay exact). A drain blocked past the grace period
+    # degrades to the hard-kill path, which the elastic requeue covers.
     import signal
 
-    signal.signal(signal.SIGTERM, lambda s, f: sys.exit(143))
+    signal.signal(signal.SIGTERM, lambda s, f: worker.request_drain())
 
     profiling = False
     if args.profile_dir:

@@ -42,6 +42,7 @@ from elasticdl_tpu.api.model_spec import ModelSpec
 from elasticdl_tpu.common.constants import (
     ENV_BENCH_MFU,
     ENV_BET_PREFETCH,
+    ENV_SCHED_PHASE_SECS,
     ENV_SYNC_COMPRESS,
     ENV_SYNC_DEPTH,
     ENV_SYNC_DTYPE,
@@ -314,6 +315,25 @@ class Worker:
         # (doc/worker_optimization_design.md:33-60): get_batch /
         # compute / get_model / report_gradient / sync_wait / read
         self.timers = PhaseTimers()
+        # policy-plane telemetry: the run loop ships cumulative timer
+        # snapshots to the master every N seconds (ReportPhaseStats —
+        # the autoscaler's signal; 0 disables). Failure-tolerant: a
+        # telemetry hiccup must never take a worker down.
+        self._phase_report_secs = float(
+            os.environ.get(ENV_SCHED_PHASE_SECS, "") or 2.0
+        )
+        self._last_phase_report = float("-inf")
+        # speculation: the current task's attempt key (dispatcher
+        # spec_key) + per-task window counter. A primary/backup pair
+        # shares spec_key, and windows never straddle tasks, so both
+        # copies derive IDENTICAL window report_keys — the second push
+        # of a window is absorbed by dedup, never double-applied.
+        self._cur_spec_key = ""
+        self._cur_window_idx = 0
+        # graceful-drain latch (SIGTERM / policy preemption): the run
+        # loop exits at the next task boundary after settling all
+        # in-flight syncs and reports
+        self._drain_requested = threading.Event()
         # Elastic embeddings compose with window mode: BET gradients
         # are extracted per step (device) and accumulated, then flushed
         # to the PS's sparse optimizer with the window's delta sync —
@@ -1411,6 +1431,15 @@ class Worker:
             # plain cast on DEVICE: halves the per-window d2h bytes
             delta_dev = delta_dev.astype(jnp.bfloat16)
         steps = self._pending_steps
+        # dedup key, fixed at spawn: deterministic when the task carries
+        # a dispatcher spec_key (speculation-stable — both copies of a
+        # speculated task name this window identically), else a fresh
+        # uuid (retry-safe only)
+        if self._cur_spec_key:
+            report_key = f"{self._cur_spec_key}.w{self._cur_window_idx}"
+            self._cur_window_idx += 1
+        else:
+            report_key = uuid.uuid4().hex
         aux_dev = self._aux  # device refs; materialized in the thread
         losses = self._pending_losses  # resolved in the same d2h round
         self._pending_losses = []
@@ -1483,6 +1512,7 @@ class Worker:
                 "steps": steps,
                 "base_version": base_version,
                 "aux_state": aux_h,
+                "report_key": report_key,
             }
             if pending_edl:
                 # the window's sparse plane: per-step IndexedRows merged
@@ -1529,6 +1559,7 @@ class Worker:
                     steps,
                     base_versions,
                     model_dtype=req.get("model_dtype"),
+                    report_key=report_key,
                 )
                 meta = {
                     "worker_id": self._id,
@@ -2163,6 +2194,13 @@ class Worker:
     def _process_training_task(self, task: Task) -> bool:
         """Returns True if the task's result report was handled here
         (deferred behind the covering sync) rather than by `run()`."""
+        # window report_keys derive from this task's dispatch-attempt
+        # key; the per-task window counter resets here and this
+        # function always ends with a window flush, so the
+        # (spec_key, window) sequence is identical across a
+        # primary/backup pair of a speculated task
+        self._cur_spec_key = task.spec_key
+        self._cur_window_idx = 0
         reader = self._readers.get(task.shard_file_name)
         with self.timers.phase("read_records"):
             records = list(reader.read_range(task.start, task.end))
@@ -2419,6 +2457,32 @@ class Worker:
 
     # ------------------------------------------------------------- main loop
 
+    def request_drain(self):
+        """Ask the run loop to exit at the next task boundary (signal
+        handlers and tests call this; it never blocks). The boundary
+        drain settles every report first — see run()."""
+        self._drain_requested.set()
+
+    def _maybe_report_phase_stats(self):
+        """Push cumulative PhaseTimers counters to the master, at most
+        every EDL_SCHED_PHASE_SECS seconds (0 disables). Telemetry is
+        best-effort: the autoscaler tolerates a missing sample, so any
+        RPC failure is swallowed — a worker must never die (or even
+        stall a task) because the stats plane hiccupped."""
+        if self._phase_report_secs <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_phase_report < self._phase_report_secs:
+            return
+        self._last_phase_report = now
+        try:
+            self._master.call(
+                "ReportPhaseStats",
+                {"worker_id": self._id, "phases": self.timers.snapshot()},
+            )
+        except Exception:
+            logger.debug("phase-stats report failed (ignored)", exc_info=True)
+
     def run(self) -> bool:
         """Task loop (reference: worker.py:432-463). Each task is pulled,
         processed to completion, and reported; failures report the error
@@ -2428,8 +2492,25 @@ class Worker:
         the job finished with failed (dropped poison) tasks — callers
         must not treat a partial-data model as a passing run."""
         while True:
+            if self._drain_requested.is_set():
+                # Policy preemption / teardown drain: exit at a TASK
+                # boundary — the in-flight sync chain joins and every
+                # deferred report lands first, so the dispatcher sees
+                # this worker's work as fully settled and recover_tasks
+                # requeues nothing. This is what makes a pod-kill
+                # preemption resume at exact versions; a drain that
+                # outlives the backend's SIGKILL grace degrades to the
+                # hard-kill (requeue) path instead.
+                with self.timers.phase("sync_wait"):
+                    self._finalize_local_updates()
+                logger.info(
+                    "Worker %d: drain requested, exiting at task boundary",
+                    self._id,
+                )
+                return True
             with self.timers.phase("get_task"):
                 task, finished = self.get_task()
+            self._maybe_report_phase_stats()
             if task.type == TaskType.WAIT:
                 if finished:
                     with self.timers.phase("sync_wait"):

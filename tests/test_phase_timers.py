@@ -1,0 +1,127 @@
+"""PhaseTimers (common/timing.py) as a telemetry source: snapshot
+merging across workers, the ReportPhaseStats wire round-trip into the
+master-side aggregator, and monotonicity of the cumulative counters
+under concurrent phase() contexts."""
+
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.common import codec
+from elasticdl_tpu.common.messages import ReportPhaseStatsRequest
+from elasticdl_tpu.common.timing import PhaseTimers
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.sched import PhaseStatsAggregator, merge_phase_snapshots
+from elasticdl_tpu.testing import InProcessMaster
+
+
+def _busy(timers, name, secs):
+    with timers.phase(name):
+        time.sleep(secs)
+
+
+def test_snapshot_merge_across_workers():
+    """Two workers' independent timers merge into one fleet snapshot
+    with summed seconds and counts."""
+    w0, w1 = PhaseTimers(), PhaseTimers()
+    _busy(w0, "compute", 0.02)
+    _busy(w0, "compute", 0.02)
+    _busy(w0, "sync_wait", 0.01)
+    _busy(w1, "compute", 0.02)
+    merged = merge_phase_snapshots([w0.snapshot(), w1.snapshot()])
+    assert merged["compute"]["count"] == 3
+    assert merged["sync_wait"]["count"] == 1
+    assert merged["compute"]["seconds"] >= 0.06 - 1e-3
+    # merging never mutates the inputs
+    assert w0.snapshot()["compute"]["count"] == 2
+
+
+def test_exclusive_time_merges_consistently():
+    """Nested phases charge exclusive time, so a merged snapshot's
+    total still sums to real wall clock (no double counting)."""
+    t = PhaseTimers()
+    with t.phase("outer"):
+        with t.phase("inner"):
+            time.sleep(0.03)
+    snap = t.snapshot()
+    total = sum(c["seconds"] for c in snap.values())
+    assert snap["inner"]["seconds"] >= 0.03 - 1e-3
+    assert snap["outer"]["seconds"] < 0.03  # exclusive: inner subtracted
+    assert total == pytest.approx(
+        snap["inner"]["seconds"] + snap["outer"]["seconds"]
+    )
+
+
+def test_report_phase_stats_wire_roundtrip_into_aggregator():
+    """A worker-shaped snapshot survives the wire codec and lands in
+    the master's PhaseStatsAggregator via the ReportPhaseStats RPC."""
+    timers = PhaseTimers()
+    _busy(timers, "compute", 0.01)
+    snap = timers.snapshot()
+
+    req = ReportPhaseStatsRequest(worker_id=3, phases=snap)
+    back = ReportPhaseStatsRequest.from_wire(
+        codec.loads(codec.dumps(req.to_wire()))
+    )
+    assert back.worker_id == 3
+    assert back.phases["compute"]["count"] == 1
+    assert back.phases["compute"]["seconds"] == pytest.approx(
+        snap["compute"]["seconds"]
+    )
+
+    servicer = MasterServicer(grads_to_wait=1, optimizer=None)
+    agg = PhaseStatsAggregator()
+    servicer.set_phase_stats_sink(agg.ingest)
+    master = InProcessMaster(servicer)
+    master.call("ReportPhaseStats", {"worker_id": 3, "phases": snap})
+    assert agg.snapshot()["workers_reporting"] == 1
+    # a second, larger cumulative sample makes the delta visible
+    _busy(timers, "compute", 0.02)
+    master.call(
+        "ReportPhaseStats", {"worker_id": 3, "phases": timers.snapshot()}
+    )
+    assert agg.recent_seconds()["compute"] > 0
+
+
+def test_missing_sink_is_a_noop_ack():
+    servicer = MasterServicer(grads_to_wait=1, optimizer=None)
+    master = InProcessMaster(servicer)
+    assert master.call("ReportPhaseStats", {"worker_id": 0, "phases": {}}) == {}
+
+
+def test_monotone_under_concurrent_phase_contexts():
+    """Many threads timing phases on ONE PhaseTimers: successive
+    snapshots must be per-phase monotone non-decreasing in both
+    seconds and count (the property the aggregator's delta math and
+    its relaunch-reset heuristic both rely on)."""
+    timers = PhaseTimers()
+    stop = threading.Event()
+
+    def work(name):
+        while not stop.is_set():
+            with timers.phase(name):
+                with timers.phase("inner"):
+                    pass
+
+    threads = [
+        threading.Thread(target=work, args=(f"phase{i}",)) for i in range(4)
+    ]
+    [t.start() for t in threads]
+    try:
+        prev = timers.snapshot()
+        for _ in range(200):
+            cur = timers.snapshot()
+            for name, cell in prev.items():
+                assert cur[name]["seconds"] >= cell["seconds"] - 1e-12, name
+                assert cur[name]["count"] >= cell["count"], name
+            prev = cur
+    finally:
+        stop.set()
+        [t.join(5) for t in threads]
+    # every worker thread contributed
+    final = timers.snapshot()
+    assert {f"phase{i}" for i in range(4)} <= set(final)
+    assert final["inner"]["count"] == sum(
+        final[f"phase{i}"]["count"] for i in range(4)
+    )

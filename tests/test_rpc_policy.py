@@ -181,12 +181,15 @@ def test_from_env_overrides(monkeypatch):
 
 def test_idempotency_classification():
     # writes with no server-side dedup must never be auto-retried
-    for m in ("GetTask", "ReportGradient", "ReportLocalUpdate",
+    for m in ("GetTask", "ReportGradient",
               "ReportWindowMeta", "EmbeddingUpdate"):
         assert m not in IDEMPOTENT_METHODS, m
     # report_key-deduped / read-only / SETNX ops must be
+    # (ReportLocalUpdate joined when the master servicer grew its own
+    # dedup ring — workers always send a report_key now)
     for m in ("PSPushGrad", "PSPushDelta", "PSPull", "PSInit",
-              "KVLookup", "KVUpdate", "GetModel", "ReportTaskResult"):
+              "KVLookup", "KVUpdate", "GetModel", "ReportTaskResult",
+              "ReportLocalUpdate"):
         assert m in IDEMPOTENT_METHODS, m
 
 

@@ -1,0 +1,435 @@
+"""Policy-plane end-to-end tests (elasticdl_tpu/sched/).
+
+Three scenarios against REAL workers:
+
+- speculative straggler backups: a stalled worker's task is cloned to
+  an idle worker, first-report-wins settles the pair, and the loser's
+  window push is absorbed by report_key dedup — exact final version;
+- utilization autoscaling: scale-up on a compute-bound signal, then a
+  policy scale-down whose victim drains at a task boundary — exact
+  final version, zero relaunches (parametrized over a lossy sync mode);
+- two-job QoS contention (slow tier): a guaranteed job's capacity
+  request preempts a best-effort ProcessBackend job's worker via the
+  arbiter; both jobs finish at exact versions.
+"""
+
+import os
+import threading
+import time
+
+import optax
+import pytest
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.cluster.pod_backend import PodBackend, PodEvent, PodPhase
+from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.master.worker_manager import WorkerManager
+from elasticdl_tpu.sched import (
+    PhaseStatsAggregator,
+    PriorityArbiter,
+    UtilizationAutoscaler,
+)
+from elasticdl_tpu.testing import InProcessMaster, write_linear_records
+from elasticdl_tpu.worker.worker import Worker
+
+from tests.fixtures import linear_module
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _spec():
+    # quartered lr for the same racing-additive-merge stability reason
+    # as test_worker_e2e's two-worker window test
+    return spec_from_module(linear_module, optimizer=lambda: optax.sgd(0.125))
+
+
+def _poll(cond, deadline_secs, msg):
+    deadline = time.time() + deadline_secs
+    while not cond():
+        assert time.time() < deadline, msg
+        time.sleep(0.02)
+
+
+# -- speculative straggler backups -------------------------------------------
+
+
+def test_speculative_backup_settles_exactly(tmp_path):
+    """One worker's first window push is stalled for seconds (a real
+    straggler: its deferred task reports stall with it). The healthy
+    worker must drain the queue, get BACKUP copies of the straggler's
+    in-flight tasks, and settle them first-report-wins; when the stall
+    ends, the duplicate window pushes are absorbed by the servicer's
+    report_key ring. The bar is exactness: every task settles once,
+    and the final version is exactly (tasks x steps-per-window)."""
+    path = str(tmp_path / "train.rio")
+    write_linear_records(path, 192, noise=0.05)
+    # 6 tasks of exactly one window each (32 records = 2 steps x 16)
+    dispatcher = TaskDispatcher(
+        {path: 192},
+        {},
+        {},
+        32,
+        1,
+        speculate=True,
+        spec_min_completed=1,
+        spec_factor=1.0,
+        max_backups=8,
+    )
+    servicer = MasterServicer(
+        grads_to_wait=1,
+        optimizer=PSOptimizer(linear_module.optimizer()),
+        task_dispatcher=dispatcher,
+        staleness_window=2,
+    )
+
+    state = {"n": 0}
+
+    def stall_first(req):
+        state["n"] += 1
+        if state["n"] == 1:
+            # stalls the calling worker's sync chain (and with it the
+            # deferred report of every task it holds) — the intercept
+            # runs in the pusher's own thread, before the handler
+            time.sleep(8.0)
+        return req
+
+    master = InProcessMaster(
+        servicer, intercept={"ReportLocalUpdate": stall_first}
+    )
+    workers = [
+        Worker(i, master, _spec(), minibatch_size=16, local_updates=2)
+        for i in range(2)
+    ]
+    threads = [threading.Thread(target=w.run) for w in workers]
+    [t.start() for t in threads]
+    [t.join(120) for t in threads]
+    assert not any(t.is_alive() for t in threads)
+
+    assert dispatcher.finished()
+    assert dispatcher.completed_records() == 192
+    # exact: 6 windows x 2 steps, every duplicate absorbed
+    assert servicer.version == 12
+
+    sched = dispatcher.sched_stats()
+    assert sched["backups_dispatched"] >= 1
+    # the pair settled through the first-report-wins path (whichever
+    # copy reported first), never twice
+    assert sched["backup_wins"] + sched["primary_wins"] >= 1
+    stats = master.call("GetSchedStats", {})
+    assert stats["duplicate_local_updates"] >= 1
+
+
+# -- utilization autoscaling over a thread backend ---------------------------
+
+
+class _ThreadBackend(PodBackend):
+    """Real Workers as in-process threads over per-worker
+    InProcessMaster shims. `delete_worker` is the GRACEFUL pod-kill
+    shape: it latches `Worker.request_drain()`, the production SIGTERM
+    path, so the victim exits at a task boundary with everything
+    settled (the hard-kill shape is the chaos tier's job). Terminal
+    events mirror ProcessBackend: DELETED when we deleted it,
+    SUCCEEDED/FAILED otherwise."""
+
+    def __init__(self, servicer, worker_kwargs, intercepts=None):
+        self._servicer = servicer
+        self._kwargs = worker_kwargs
+        self._intercepts = intercepts or {}
+        self._cb = None
+        self._workers = {}
+        self._threads = {}
+        self._deleted = set()
+
+    def set_event_callback(self, cb):
+        self._cb = cb
+
+    def start_worker(self, worker_id, argv, envs):
+        master = InProcessMaster(
+            self._servicer, intercept=self._intercepts.get(worker_id)
+        )
+        worker = Worker(worker_id, master, _spec(), **self._kwargs)
+        self._workers[worker_id] = worker
+
+        def run():
+            phase = PodPhase.SUCCEEDED
+            try:
+                worker.run()
+            except BaseException:
+                phase = PodPhase.FAILED
+            if worker_id in self._deleted:
+                phase = PodPhase.DELETED
+            if self._cb is not None:
+                self._cb(PodEvent(worker_id, phase, exit_code=0))
+
+        t = threading.Thread(target=run, daemon=True, name=f"edl-w{worker_id}")
+        self._threads[worker_id] = t
+        if self._cb is not None:
+            self._cb(PodEvent(worker_id, PodPhase.RUNNING))
+        t.start()
+
+    def delete_worker(self, worker_id):
+        self._deleted.add(worker_id)
+        self._workers[worker_id].request_drain()
+
+    def stop(self):
+        for wid in list(self._workers):
+            self.delete_worker(wid)
+        for t in self._threads.values():
+            t.join(30)
+
+
+@pytest.mark.parametrize("sync_dtype", [None, "int8"], ids=["f32", "int8"])
+def test_autoscaler_resizes_preserve_exactness(tmp_path, sync_dtype):
+    """Scale-up on a compute-bound fleet signal, scale-down on a
+    sync_wait-bound one, against a live window-mode job. The scale-down
+    victim (the youngest worker, mid-job, holding recent work) drains
+    at a task boundary, so: exact final version, zero relaunches, and
+    the resize counters account for every action. Worker 0 is gated at
+    GetTask until the resize choreography is done, which pins the
+    sequencing: worker 1 (the scaled-up worker) does the early tasks
+    and is then the policy victim."""
+    path = str(tmp_path / "train.rio")
+    write_linear_records(path, 768, noise=0.05)
+    dispatcher = TaskDispatcher({path: 768}, {}, {}, 32, 1)  # 24 tasks
+    servicer = MasterServicer(
+        grads_to_wait=1,
+        optimizer=PSOptimizer(linear_module.optimizer()),
+        task_dispatcher=dispatcher,
+        staleness_window=2,
+    )
+    gate0 = threading.Event()
+
+    def hold_gate(req):
+        gate0.wait()
+        return req
+
+    kwargs = {"minibatch_size": 16, "local_updates": 2}
+    if sync_dtype:
+        kwargs["sync_dtype"] = sync_dtype
+    backend = _ThreadBackend(
+        servicer, kwargs, intercepts={0: {"GetTask": hold_gate}}
+    )
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=1,
+        worker_argv_fn=lambda wid: [],
+        max_relaunches=2,
+    )
+    clk = {"t": 0.0}
+    agg = PhaseStatsAggregator(clock=lambda: clk["t"])
+    auto = UtilizationAutoscaler(
+        agg,
+        manager,
+        min_workers=1,
+        max_workers=2,
+        up_threshold=0.6,
+        down_threshold=0.5,
+        cooldown_secs=5.0,
+        pending_fn=dispatcher.pending_count,
+        clock=lambda: clk["t"],
+    )
+    try:
+        manager.start_workers()  # worker 0, parked at the gate
+
+        # compute-dominant deltas -> scale up (there IS pending work)
+        agg.ingest(0, {"compute": {"seconds": 0.0, "count": 0}})
+        clk["t"] = 5.0
+        agg.ingest(
+            0,
+            {
+                "compute": {"seconds": 9.0, "count": 9},
+                "sync_wait": {"seconds": 1.0, "count": 1},
+            },
+        )
+        assert auto.tick() == "up"  # starts worker 1 (ungated)
+
+        _poll(
+            lambda: dispatcher.completed_records() >= 32,
+            120,
+            "scaled-up worker made no progress",
+        )
+
+        # sync_wait-dominant deltas, past the cooldown -> scale down
+        clk["t"] = 100.0
+        agg.ingest(
+            0,
+            {
+                "compute": {"seconds": 9.5, "count": 10},
+                "sync_wait": {"seconds": 30.0, "count": 5},
+            },
+        )
+        assert auto.tick() == "down"
+        _poll(
+            lambda: manager.snapshot()["phases"].get(1)
+            in (PodPhase.DELETED, PodPhase.SUCCEEDED, PodPhase.FAILED),
+            60,
+            "policy victim never exited",
+        )
+        gate0.set()  # worker 0 finishes the job alone
+        _poll(lambda: dispatcher.finished(), 120, "job stuck after resize")
+        # let the survivors see `finished` and exit by themselves —
+        # tearing the backend down first would DELETE a live worker
+        # and spend a relaunch on it
+        _poll(
+            lambda: manager.snapshot()["live"] == 0,
+            60,
+            "workers did not exit after job finished",
+        )
+    finally:
+        gate0.set()
+        manager.stop_relaunch_and_remove_workers()
+        backend.stop()
+
+    assert dispatcher.completed_records() == 768
+    # exact: 24 windows x 2 steps each, nothing double-applied by the
+    # resize (the drained victim's tasks were fully settled, so
+    # recover_tasks had nothing to requeue)
+    assert servicer.version == 48
+    snap = manager.snapshot()
+    assert snap["scale_ups"] == 1
+    assert snap["scale_downs"] == 1
+    assert snap["policy_stops"] == 1
+    assert snap["relaunches"] == 0
+    # the victim was the youngest worker and went through the
+    # policy-delete path, not a failure
+    assert snap["phases"][1] == PodPhase.DELETED
+    stats = auto.stats()
+    assert stats["scale_ups"] == 1 and stats["scale_downs"] == 1
+
+
+# -- two-job QoS contention over ProcessBackend (slow tier) ------------------
+
+
+def _start_process_job(tmp, tag, n_records, num_epochs, num_workers, qos):
+    """One window-mode ProcessBackend job against its own master.
+    Returns the live handles the contention test choreographs."""
+    from elasticdl_tpu.common.args import master_parser, worker_forward_args
+    from elasticdl_tpu.master.main import build_master
+    from elasticdl_tpu.rpc.server import RpcServer
+
+    data_dir = os.path.join(tmp, f"data-{tag}")
+    os.makedirs(data_dir, exist_ok=True)
+    write_linear_records(
+        os.path.join(data_dir, "train.rio"), n_records, noise=0.05
+    )
+    args = master_parser().parse_args(
+        [
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+            "--training_data_dir", data_dir,
+            "--records_per_task", "32",
+            "--num_epochs", str(num_epochs),
+            "--grads_to_wait", "1",
+            "--num_workers", str(num_workers),
+            "--worker_backend", "process",
+            "--local_updates", "2",
+            "--staleness_window", "2",
+            "--qos_class", qos,
+        ]
+    )
+    from elasticdl_tpu.cluster.pod_backend import ProcessBackend
+
+    _spec_, dispatcher, servicer, _evs, _ckpt = build_master(args, "training")
+    server = RpcServer(servicer.handlers(), port=0)
+    server.start()
+    backend = ProcessBackend(log_dir=os.path.join(tmp, f"logs-{tag}"))
+    manager = WorkerManager(
+        backend,
+        dispatcher,
+        num_workers=num_workers,
+        worker_argv_fn=lambda wid: worker_forward_args(
+            args, wid, f"localhost:{server.port}"
+        ),
+        envs={"JAX_PLATFORMS": "cpu"},
+        max_relaunches=4,
+    )
+    return {
+        "dispatcher": dispatcher,
+        "servicer": servicer,
+        "server": server,
+        "backend": backend,
+        "manager": manager,
+    }
+
+
+def _stop_process_job(job):
+    job["manager"].stop_relaunch_and_remove_workers()
+    job["backend"].stop()
+    job["server"].stop()
+    if job["servicer"].ps_group is not None:
+        job["servicer"].ps_group.stop()
+
+
+@pytest.mark.e2e
+@pytest.mark.slow
+def test_two_job_contention_guaranteed_preempts_best_effort(tmp_path):
+    """The multi-tenant acceptance run: a best-effort job holds the
+    whole 2-token fleet; a guaranteed job's capacity request preempts
+    one token through the arbiter, which SIGTERMs a real best-effort
+    worker (graceful drain). Both jobs must finish — the best-effort
+    job on its surviving worker — at their exact expected versions,
+    with the preemption visible in every counter it crosses."""
+    tmp = str(tmp_path)
+    arbiter = PriorityArbiter(capacity=2)
+
+    # 256 records / 32 per task x 4 epochs = 32 task execs, 2 steps each
+    be = _start_process_job(tmp, "be", 256, 4, 2, "best-effort")
+    handle_be = arbiter.register(
+        "be", "best-effort", preempt_cb=be["manager"].scale_down
+    )
+    assert arbiter.request(handle_be, 2) == 2
+    be["manager"].start_workers()
+    try:
+        _poll(
+            lambda: be["dispatcher"].completed_records() >= 32,
+            180,
+            "best-effort job made no progress",
+        )
+
+        # saturated pool: the guaranteed request must preempt. The
+        # request call itself runs the preemption synchronously —
+        # scale_down SIGTERMs the youngest best-effort worker and
+        # waits for it to drain out.
+        handle_g = arbiter.register("g", "guaranteed")
+        assert arbiter.request(handle_g, 1) == 1
+        assert arbiter.stats()["preemptions"] == 1
+        assert handle_be.granted == 1 and handle_be.preempted == 1
+
+        # 128 records / 32 per task x 2 epochs = 8 task execs
+        g = _start_process_job(tmp, "g", 128, 2, 1, "guaranteed")
+        g["manager"].start_workers()
+        try:
+            _poll(
+                lambda: g["dispatcher"].finished(),
+                300,
+                "guaranteed job stuck",
+            )
+            _poll(
+                lambda: be["dispatcher"].finished(),
+                300,
+                "best-effort job stuck after preemption",
+            )
+            assert not g["dispatcher"].has_failed_tasks()
+            assert not be["dispatcher"].has_failed_tasks()
+            # exact accounting on BOTH sides of the preemption: every
+            # record exactly once, final versions exactly
+            # task-execs x 2 steps — the drained victim left nothing
+            # half-applied and its replacement-free requeue added
+            # nothing
+            assert g["dispatcher"].completed_records() == 256
+            assert g["servicer"].version == 16
+            assert be["dispatcher"].completed_records() == 1024
+            assert be["servicer"].version == 64
+        finally:
+            _stop_process_job(g)
+        snap = be["manager"].snapshot()
+        assert snap["policy_stops"] == 1
+        assert snap["scale_downs"] == 1
+        # a policy stop is not a failure: no relaunch was spent on it
+        assert snap["relaunches"] == 0
+    finally:
+        _stop_process_job(be)
