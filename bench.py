@@ -212,6 +212,100 @@ def _probe_link_mbps() -> float:
     return mbps
 
 
+def _pull_fanout_cell(
+    tier: str,
+    *,
+    n_workers: int = 8,
+    pulls_each: int = 16,
+    slice_len: int = 1 << 20,
+):
+    """N concurrent clients pulling one PS shard's model over `tier`.
+
+    Prices the prepacked model-down path: the shard encodes each
+    (version, wire-form) once and serves every pull of that version
+    from the cached frame. Over shm the frame is published into a
+    broadcast segment that each puller maps — the serve path performs
+    ZERO payload copies (asserted via the shard's encode-copy counter);
+    over uds the shared frame is still encoded once but each response
+    pays a socket write. Returns the prepack counters + pulls/sec."""
+    import threading
+
+    import numpy as np
+
+    from elasticdl_tpu.common.constants import ENV_TRANSPORT
+    from elasticdl_tpu.master.ps_shard import PSShardServicer
+    from elasticdl_tpu.rpc.client import RpcClient
+    from elasticdl_tpu.rpc.server import RpcServer
+
+    prev = os.environ.get(ENV_TRANSPORT)
+    os.environ[ENV_TRANSPORT] = tier
+    try:
+        servicer = PSShardServicer(0, 1)
+        server = RpcServer(servicer.handlers(), port=0)
+        servicer.attach_wire_stats(server.wire)
+        servicer.attach_shm_publisher(server.shm_broadcaster)
+        server.start()
+        endpoint = f"localhost:{server.port}"
+        init = RpcClient(endpoint)
+        init.call(
+            "PSInit", {"vec": np.zeros(slice_len, np.float32), "version": 0}
+        )
+        errors = []
+
+        def puller():
+            try:
+                cli = RpcClient(endpoint)
+                for _ in range(pulls_each):
+                    cli.call("PSPull", {})
+                cli.close()
+            except BaseException as e:  # surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=puller, daemon=True)
+            for _ in range(n_workers)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        stats = servicer.stats()
+        init.close()
+    finally:
+        try:
+            server.stop()
+        except Exception:
+            pass
+        if prev is None:
+            os.environ.pop(ENV_TRANSPORT, None)
+        else:
+            os.environ[ENV_TRANSPORT] = prev
+    encodes = stats["prepack_encodes"]
+    served = stats["prepack_served_pulls"]
+    copied = stats["prepack_encode_copy_bytes"]
+    assert served == n_workers * pulls_each, (served, n_workers, pulls_each)
+    # the acceptance counter: one encode amortizes across the fan-out
+    # (first-pull races can encode more than once; each must still
+    # serve >= N pulls on average)
+    assert served // max(1, encodes) >= n_workers, (served, encodes)
+    if tier == "shm":
+        assert copied == 0, (
+            f"shm pull-serve path copied {copied} payload bytes — the "
+            "broadcast publish must pack straight into the segment"
+        )
+    return {
+        "pulls_per_sec": round(served / elapsed, 1),
+        "prepack_encodes": encodes,
+        "prepack_served_pulls": served,
+        "pulls_served_per_encode": round(served / max(1, encodes), 1),
+        "prepack_encode_copy_bytes": copied,
+    }
+
+
 def _tpu_alive(timeout: float = 180.0) -> bool:
     """Probe the (possibly tunneled) TPU in a SUBPROCESS with a hard
     timeout: a wedged remote tunnel hangs the first device op forever
@@ -542,11 +636,14 @@ def main():
     )
 
     # ---- transport tiers: co-located fast paths vs gRPC ----
-    # Same short job over the inproc and uds tiers; the per-tier wire
-    # rollup must show the timed region riding the fast path — any
-    # bytes under "grpc" mean the tier silently fell back.
+    # Same short job over the inproc, uds and shm tiers; the per-tier
+    # wire rollup must show the timed region riding the fast path — any
+    # bytes under "grpc" mean the tier silently fell back. The shm tier
+    # additionally asserts ZERO uds bytes: its frames move through
+    # mapped rings, and the doorbell socket carries only handshakes
+    # (which WireStats never counts as uds traffic).
     tier_runs = {}
-    for tier in ("inproc", "uds"):
+    for tier in ("inproc", "uds", "shm"):
         t_imgs, t_worker, _ = run_job(
             model_module,
             path,
@@ -567,6 +664,15 @@ def main():
             f"{tier} tier leaked {grpc_bytes} bytes onto gRPC — "
             "co-located fast path silently fell back"
         )
+        if tier == "shm":
+            uds_row = tr.get("uds") or {}
+            uds_bytes = (
+                uds_row.get("bytes_sent", 0) + uds_row.get("bytes_received", 0)
+            )
+            assert uds_bytes == 0, (
+                f"shm tier leaked {uds_bytes} bytes onto uds — "
+                "ring path silently fell back to the socket tier"
+            )
         tier_runs[tier] = {
             "images_per_sec": round(t_imgs, 1),
             "bytes_per_sync_up": t_worker.wire_summary["bytes_per_sync_up"],
@@ -577,6 +683,23 @@ def main():
             f"bench[window transport={tier}]: {t_imgs:.1f} img/s; "
             f"{t_worker.wire_summary['bytes_per_sync_up']} B/sync up on "
             f"the {tier} tier; grpc bytes {grpc_bytes}",
+            file=sys.stderr,
+        )
+
+    # ---- prepacked model-down broadcast: pull fan-out shm vs uds ----
+    # N clients pulling the same PS model version: the prepack cache
+    # encodes each (version, wire-form) ONCE and serves the whole
+    # fan-out from it; over shm the payload additionally rides a
+    # broadcast segment every puller maps (0 encode copies, asserted).
+    pull_fanout = {
+        tier: _pull_fanout_cell(tier) for tier in ("uds", "shm")
+    }
+    for tier, cell in pull_fanout.items():
+        print(
+            f"bench[pull-fanout {tier}]: {cell['pulls_per_sec']} pulls/s; "
+            f"{cell['pulls_served_per_encode']} pulls served per encode "
+            f"({cell['prepack_encodes']} encodes, "
+            f"{cell['prepack_encode_copy_bytes']} copy bytes)",
             file=sys.stderr,
         )
 
@@ -621,133 +744,169 @@ def main():
             file=sys.stderr,
         )
 
-    print(
-        json.dumps(
-            {
-                "metric": "cifar10_ps_training_images_per_sec",
-                "value": round(imgs_per_sec, 1),
-                "unit": "images/sec",
-                # True when a TPU was registered but its tunnel never
-                # answered the liveness probe: the numbers below are
-                # the CPU smoke protocol, not chip numbers — compare
-                # against the round's committed chip results in
-                # docs/performance.md instead
-                "tpu_unreachable": tpu_unreachable,
-                "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
-                "per_step_images_per_sec": round(ps_imgs_per_sec, 1),
-                "per_step_serial_images_per_sec": round(ps_serial_imgs, 1),
-                # wire-byte accounting (rpc/policy.WireStats, timed
-                # region only): the window/per-step runs ride the bf16
-                # EF sync plane (--sync_dtype bf16), so bytes_per_sync
-                # here vs a float32 run is the codec win measured, not
-                # estimated
-                "window_wire": worker.wire_summary,
-                "per_step_wire": ps_worker.wire_summary,
-                "sync_dtype": "bfloat16",
-                # compressed sync plane: int8 per-chunk quantization +
-                # top-k 5% sparsification (EF-folded), priced against a
-                # same-shape f32 run and convergence-gated on TPU
-                "wire_f32_baseline": f32_worker.wire_summary,
-                "wire_compressed": {
-                    **comp_worker.wire_summary,
-                    "sync_dtype": "int8",
-                    "sync_compress": "topk:0.05",
-                    "images_per_sec": round(comp_imgs, 1),
-                    "tail_loss": round(comp_tail, 4),
-                },
-                "compressed_bytes_per_sync_ratio_vs_f32": compress_ratio,
-                # co-located transport fast paths: each run's wire
-                # rollup is split per tier; grpc_bytes_total == 0 is
-                # asserted above (no silent fallback)
-                "transport_tiers": tier_runs,
-                "deepfm_sparse_window_records_per_sec": dfm_recs_per_sec,
-                "deepfm_bet_prefetch_ab": dfm_pair,
-                # async master core: blocking thread-per-request vs
-                # event-loop dispatch + fan-in combining, N pushers vs
-                # one PS shard (bench_fanin.py holds the full-window
-                # acceptance run; this is the same protocol, short
-                # windows)
-                "fanin": fanin,
-                "resnet50_chip": resnet,
-                "window_runs_images_per_sec": [
-                    round(a[0], 1) for a in attempts
-                ],
-                # weather normalization: the window protocol is bound by
-                # the host<->device link on this host, so img/s scales
-                # ~linearly with the measured h2d bandwidth; the ratio
-                # separates code changes from link weather across rounds
-                "link_mbps_per_run": link_mbps,
-                # the degradation gate: runs whose bracketing probes sat
-                # below the floor are excluded from best-of (and each
-                # earned a replacement attempt); True entries align with
-                # window_runs_images_per_sec
-                "link_floor_mbps": link_floor,
-                "link_degraded_runs": link_degraded,
-                "headline_link_mbps": (
-                    link_mbps[best_i] if link_mbps else None
-                ),
-                "window_imgs_per_sec_per_link_mbps": (
-                    round(imgs_per_sec / link_mbps[best_i], 3)
-                    if link_mbps
-                    else None
-                ),
-                "tail_loss": round(tail, 4),
-                "model_tflops_per_sec": (
-                    round(tflops_per_sec, 3) if tflops_per_sec else None
-                ),
-                "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu else None,
-                "protocol": (
-                    "steady-state: programs AOT-compiled+executed once "
-                    "before the timed region (reference 23.8s figure is "
-                    "likewise post-tf.function-tracing); window mode "
-                    "headline = best of 2 runs, each gated on "
-                    "convergence and on the link floor (a run probing "
-                    "below link_floor_mbps is marked in "
-                    "link_degraded_runs, excluded from best-of, and "
-                    "replaced by one extra attempt) "
-                    "(window_runs_images_per_sec lists "
-                    "all; the shared accelerator link swings "
-                    "several-fold between minutes — link_mbps_per_run "
-                    "records max(h2d bandwidth probed immediately "
-                    "before, immediately after) each run (a single "
-                    "instantaneous probe can miss the run's real "
-                    "weather), and "
-                    "window_imgs_per_sec_per_link_mbps is the "
-                    "weather-normalized secondary: the window protocol "
-                    "is link-bound here, so compare THAT ratio across "
-                    "rounds, not the raw headline); per-step sync-SGD "
-                    "secondary, measured pipelined (staleness_window=4, "
-                    "step_pipeline=4: up to 4 reports in flight divide "
-                    "the report round's latency across 4 batches) and "
-                    "serial. The serial variant is bound by the "
-                    "host<->accelerator link on this machine (a "
-                    "~90ms-latency tunnel: ~97% of its wall is the "
-                    "grad-up/model-down round per minibatch); the "
-                    "pipeline hides it behind compute — on a co-located "
-                    "TPU-VM the same path pays microseconds of PCIe/ICI "
-                    "latency per round instead. The deepfm number is "
-                    "the elastic-embedding sparse plane through window "
-                    "mode (per-batch BET lookups, accumulated "
-                    "IndexedRows riding each delta sync), reported as a "
-                    "same-run A/B pair: prefetch_off fetches each "
-                    "batch's rows inline, prefetch_on overlaps batch "
-                    "N+1's lookups + lazy-init draws with batch N's "
-                    "compute on a background thread (off runs first, "
-                    "biasing against the feature); resnet50_chip "
-                    "is the north-star model's device-resident full "
-                    "train step (see bench_resnet.py for the "
-                    "elastic-runtime variant and the input-bandwidth "
-                    "physics). wire_compressed is the int8+topk:0.05 "
-                    "EF sync plane priced against wire_f32_baseline "
-                    "(same job shape, f32 wire), convergence-gated "
-                    "like the headline; transport_tiers re-runs the "
-                    "short window job over the co-located inproc and "
-                    "uds fast paths with the per-tier byte split "
-                    "(grpc bytes asserted 0 — no silent fallback)"
-                ),
-            }
+    record = {
+        "metric": "cifar10_ps_training_images_per_sec",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec",
+        # True when a TPU was registered but its tunnel never
+        # answered the liveness probe: the numbers below are
+        # the CPU smoke protocol, not chip numbers — compare
+        # against the round's committed chip results in
+        # docs/performance.md instead
+        "tpu_unreachable": tpu_unreachable,
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+        "per_step_images_per_sec": round(ps_imgs_per_sec, 1),
+        "per_step_serial_images_per_sec": round(ps_serial_imgs, 1),
+        # wire-byte accounting (rpc/policy.WireStats, timed
+        # region only): the window/per-step runs ride the bf16
+        # EF sync plane (--sync_dtype bf16), so bytes_per_sync
+        # here vs a float32 run is the codec win measured, not
+        # estimated
+        "window_wire": worker.wire_summary,
+        "per_step_wire": ps_worker.wire_summary,
+        "sync_dtype": "bfloat16",
+        # compressed sync plane: int8 per-chunk quantization +
+        # top-k 5% sparsification (EF-folded), priced against a
+        # same-shape f32 run and convergence-gated on TPU
+        "wire_f32_baseline": f32_worker.wire_summary,
+        "wire_compressed": {
+            **comp_worker.wire_summary,
+            "sync_dtype": "int8",
+            "sync_compress": "topk:0.05",
+            "images_per_sec": round(comp_imgs, 1),
+            "tail_loss": round(comp_tail, 4),
+        },
+        "compressed_bytes_per_sync_ratio_vs_f32": compress_ratio,
+        # co-located transport fast paths: each run's wire
+        # rollup is split per tier; grpc_bytes_total == 0 is
+        # asserted above (no silent fallback), and the shm run
+        # additionally asserted 0 uds bytes
+        "transport_tiers": tier_runs,
+        # prepacked model-down broadcast: N pullers served from
+        # one cached encode per (version, wire-form); the shm
+        # cell asserted 0 payload-copy bytes on the serve path
+        "pull_fanout": pull_fanout,
+        "deepfm_sparse_window_records_per_sec": dfm_recs_per_sec,
+        "deepfm_bet_prefetch_ab": dfm_pair,
+        # async master core: blocking thread-per-request vs
+        # event-loop dispatch + fan-in combining, N pushers vs
+        # one PS shard (bench_fanin.py holds the full-window
+        # acceptance run; this is the same protocol, short
+        # windows)
+        "fanin": fanin,
+        "resnet50_chip": resnet,
+        "window_runs_images_per_sec": [
+            round(a[0], 1) for a in attempts
+        ],
+        # weather normalization: the window protocol is bound by
+        # the host<->device link on this host, so img/s scales
+        # ~linearly with the measured h2d bandwidth; the ratio
+        # separates code changes from link weather across rounds
+        "link_mbps_per_run": link_mbps,
+        # the degradation gate: runs whose bracketing probes sat
+        # below the floor are excluded from best-of (and each
+        # earned a replacement attempt); True entries align with
+        # window_runs_images_per_sec
+        "link_floor_mbps": link_floor,
+        "link_degraded_runs": link_degraded,
+        "headline_link_mbps": (
+            link_mbps[best_i] if link_mbps else None
+        ),
+        "window_imgs_per_sec_per_link_mbps": (
+            round(imgs_per_sec / link_mbps[best_i], 3)
+            if link_mbps
+            else None
+        ),
+        "tail_loss": round(tail, 4),
+        "model_tflops_per_sec": (
+            round(tflops_per_sec, 3) if tflops_per_sec else None
+        ),
+        "mfu_vs_v5e_bf16_peak": round(mfu, 4) if mfu else None,
+        "protocol": (
+            "steady-state: programs AOT-compiled+executed once "
+            "before the timed region (reference 23.8s figure is "
+            "likewise post-tf.function-tracing); window mode "
+            "headline = best of 2 runs, each gated on "
+            "convergence and on the link floor (a run probing "
+            "below link_floor_mbps is marked in "
+            "link_degraded_runs, excluded from best-of, and "
+            "replaced by one extra attempt) "
+            "(window_runs_images_per_sec lists "
+            "all; the shared accelerator link swings "
+            "several-fold between minutes — link_mbps_per_run "
+            "records max(h2d bandwidth probed immediately "
+            "before, immediately after) each run (a single "
+            "instantaneous probe can miss the run's real "
+            "weather), and "
+            "window_imgs_per_sec_per_link_mbps is the "
+            "weather-normalized secondary: the window protocol "
+            "is link-bound here, so compare THAT ratio across "
+            "rounds, not the raw headline); per-step sync-SGD "
+            "secondary, measured pipelined (staleness_window=4, "
+            "step_pipeline=4: up to 4 reports in flight divide "
+            "the report round's latency across 4 batches) and "
+            "serial. The serial variant is bound by the "
+            "host<->accelerator link on this machine (a "
+            "~90ms-latency tunnel: ~97% of its wall is the "
+            "grad-up/model-down round per minibatch); the "
+            "pipeline hides it behind compute — on a co-located "
+            "TPU-VM the same path pays microseconds of PCIe/ICI "
+            "latency per round instead. The deepfm number is "
+            "the elastic-embedding sparse plane through window "
+            "mode (per-batch BET lookups, accumulated "
+            "IndexedRows riding each delta sync), reported as a "
+            "same-run A/B pair: prefetch_off fetches each "
+            "batch's rows inline, prefetch_on overlaps batch "
+            "N+1's lookups + lazy-init draws with batch N's "
+            "compute on a background thread (off runs first, "
+            "biasing against the feature); resnet50_chip "
+            "is the north-star model's device-resident full "
+            "train step (see bench_resnet.py for the "
+            "elastic-runtime variant and the input-bandwidth "
+            "physics). wire_compressed is the int8+topk:0.05 "
+            "EF sync plane priced against wire_f32_baseline "
+            "(same job shape, f32 wire), convergence-gated "
+            "like the headline; transport_tiers re-runs the "
+            "short window job over the co-located inproc, uds "
+            "and shm fast paths with the per-tier byte split "
+            "(grpc bytes asserted 0 — no silent fallback; the "
+            "shm run also asserts 0 uds bytes). pull_fanout "
+            "prices the prepacked model-down broadcast: 8 "
+            "clients x 16 pulls of one 4 MB model version, "
+            "served from one cached encode (over shm via a "
+            "mapped broadcast segment, 0 payload copies). "
+            "Fields reported null carry a sibling "
+            "<field>_skipped_reason stating why the number is "
+            "absent from this run"
+        ),
+    }
+    # honest-null protocol: a headline field reported null MUST say why
+    # (a bare null reads as \"not applicable\" when it often means \"the
+    # probe was skipped on this backend\") — every null top-level field
+    # gains a <field>_skipped_reason sibling
+    skip_reasons = {
+        "resnet50_chip": (
+            f"backend is {backend!r}; the ResNet-50 chip-throughput "
+            "bench runs only on tpu"
+        ),
+        "model_tflops_per_sec": (
+            "worker reported no window FLOP count (XLA cost analysis "
+            f"unavailable on backend {backend!r})"
+        ),
+        "mfu_vs_v5e_bf16_peak": (
+            "MFU derives from model_tflops_per_sec, which this run "
+            "could not measure"
+        ),
+        "headline_link_mbps": "no window run recorded a link probe",
+        "window_imgs_per_sec_per_link_mbps": (
+            "no window run recorded a link probe"
+        ),
+    }
+    for field in [k for k, v in record.items() if v is None]:
+        record[f"{field}_skipped_reason"] = skip_reasons.get(
+            field, "not measured on this backend/run"
         )
-    )
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

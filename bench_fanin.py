@@ -31,14 +31,17 @@ asserts version == applied_pushes (no report lost or double-applied).
 Grid: wire in {f32 (dense 4 MB slice), topk (1% top-k sparse over the
 same slice)} x N in {8, 64, 256} x tier x core in {blocking (threads
 dispatch, no combine), loop_combine}. The inproc tier runs both wires;
-the uds tier runs ONLY the topk wire — shipping dense 4 MB frames
-through a Unix socket measures memcpy throughput, not dispatch (both
-cores bottleneck on moving the same bytes), and the compressed wire
-tier exists precisely because raw bytes are the socket-path bottleneck
-(see docs/performance.md). The acceptance bar is the N=256 speedup of
-loop_combine over blocking on the same machine (>= 4x on the best
-cell; the top-k cell is the headline — that is the wire form
-fan-in-at-scale deployments ship).
+the uds and shm tiers run ONLY the topk wire — shipping dense 4 MB
+frames through a socket/ring measures memcpy throughput, not dispatch
+(both cores bottleneck on moving the same bytes), and the compressed
+wire tier exists precisely because raw bytes are the socket-path
+bottleneck (see docs/performance.md). The shm tier moves each frame
+through a per-connection shared-memory ring (one doorbell wake per
+call, no kernel copy of the payload), so its columns price the
+zero-copy transport against uds on identical requests. The acceptance
+bar is the N=256 speedup of loop_combine over blocking on the same
+machine (>= 4x on the best cell; the top-k cell is the headline — that
+is the wire form fan-in-at-scale deployments ship).
 
 Prints ONE JSON line; also importable (`run_suite`) so bench.py embeds
 the numbers in its own JSON record.
@@ -58,8 +61,13 @@ import numpy as np
 
 DEFAULT_NS = (8, 64, 256)
 #: tier -> wire forms benched on it (module docstring: dense frames
-#: over a socket measure memcpy, not dispatch, so uds runs topk only)
-DEFAULT_GRID = (("inproc", ("f32", "topk")), ("uds", ("topk",)))
+#: over a socket measure memcpy, not dispatch, so the socket-shaped
+#: tiers — uds and the shared-memory ring tier — run topk only)
+DEFAULT_GRID = (
+    ("inproc", ("f32", "topk")),
+    ("uds", ("topk",)),
+    ("shm", ("topk",)),
+)
 DEFAULT_SLICE = 1 << 20  # 4 MB of f32 per report — a realistic PS slice
 TOPK_DENSITY = 0.01
 #: exactly representable in f32 at any summation order/grouping, so the
@@ -184,6 +192,9 @@ def run_cell(
         ]
         stats = servicer.stats()
         version = stats["version"]
+        # which tiers actually carried the cell (the shm smoke asserts
+        # 0 grpc/uds bytes — no silent fallback to a socket path)
+        transports = server.wire_stats().get("transports", {})
     finally:
         try:
             server.stop()
@@ -216,6 +227,7 @@ def run_cell(
         # (each push is steps=1), no report lost or double-applied
         "version": version,
         "applied_pushes": stats["applied_pushes"],
+        "server_transports": transports,
     }
 
 

@@ -71,6 +71,8 @@ ENV_SYNC_DTYPE = "EDL_SYNC_DTYPE"
 ENV_SYNC_COMPRESS = "EDL_SYNC_COMPRESS"
 ENV_TRANSPORT = "EDL_TRANSPORT"
 ENV_UDS_DIR = "EDL_UDS_DIR"
+ENV_TRANSPORT_SHM_RING = "EDL_TRANSPORT_SHM_RING_BYTES"
+ENV_TRANSPORT_SHM_DOORBELL_TIMEOUT = "EDL_TRANSPORT_SHM_DOORBELL_TIMEOUT"
 ENV_DISPATCH = "EDL_DISPATCH"
 ENV_DISPATCH_EXECUTOR = "EDL_DISPATCH_EXECUTOR"
 ENV_QUEUE_DEPTH_REPORT = "EDL_QUEUE_DEPTH_REPORT"
@@ -135,15 +137,29 @@ ENV_REGISTRY = {
     ),
     ENV_TRANSPORT: (
         "RPC transport tier: grpc (default), uds (Unix-domain-socket "
-        "fast path to co-located shards), inproc (same-interpreter "
-        "direct dispatch), or auto (prefer inproc, then uds, then "
-        "grpc); non-grpc tiers apply when the endpoint resolves local, "
-        "else fall back to grpc (rpc/transport.py)"
+        "fast path to co-located shards), shm (shared-memory rings "
+        "with a UDS doorbell — codec frames never cross a socket), "
+        "inproc (same-interpreter direct dispatch), or auto (prefer "
+        "inproc, then shm, then uds, then grpc); non-grpc tiers apply "
+        "when the endpoint resolves local, else fall back to grpc "
+        "(rpc/transport.py)"
     ),
     ENV_UDS_DIR: (
-        "directory for the UDS fast-path sockets (edl-uds-<port>.sock; "
-        "default: the system temp dir — must be shared by co-located "
-        "processes)"
+        "directory for the UDS fast-path sockets (edl-uds-<port>.sock) "
+        "and the shm tier's doorbell sockets + rendezvous files "
+        "(edl-shm-<port>.{sock,json}); default: the system temp dir — "
+        "must be shared by co-located processes"
+    ),
+    ENV_TRANSPORT_SHM_RING: (
+        "shm tier: per-direction ring capacity in bytes for each "
+        "connection's shared-memory segment (default 4194304 = 4 MiB, "
+        "rounded up to the 64-byte codec segment alignment); frames "
+        "larger than the ring fall back to a chunked copy path"
+    ),
+    ENV_TRANSPORT_SHM_DOORBELL_TIMEOUT: (
+        "shm tier: seconds for doorbell handshake and chunk-ack socket "
+        "operations (default 5.0); per-call deadlines still come from "
+        "the caller's RPC timeout budget"
     ),
     ENV_DISPATCH: (
         "server dispatch core: threads (default; blocking "

@@ -376,12 +376,51 @@ class Prepacked:
     stage (master/fanin.py) answers every member of a batch with the
     same merged-model payload; packing it once and handing the SAME
     bytes to each member's transport turns k response serializations
-    into one. `pack` passes the bytes through untouched."""
+    into one. `pack` passes the bytes through untouched.
 
-    __slots__ = ("data",)
+    Two extensions carry the shm broadcast plane (rpc/transport.py):
+    `shm_ref` names a published read-only broadcast segment holding
+    these same frame bytes — the shm tier answers with a tiny marker
+    the client resolves against its own mapping instead of moving the
+    frame — and `source` defers materializing `data` until a
+    socket-bound tier actually needs a private bytes object (the
+    broadcast encode writes the frame straight into the segment, so
+    shm-only fan-out never pays the join).
 
-    def __init__(self, data: bytes):
-        self.data = data
+    Mapping-style reads (`resp["vec"]`, `resp.get(...)`) decode the
+    frame lazily, so a handler returning Prepacked still duck-types as
+    its response dict for direct (non-RPC) callers."""
+
+    __slots__ = ("_data", "_source", "_obj", "shm_ref")
+
+    def __init__(self, data: Optional[bytes] = None, source=None,
+                 shm_ref: Optional[dict] = None):
+        if data is None and source is None:
+            raise ValueError("Prepacked needs frame bytes or a source")
+        self._data = data
+        self._source = source
+        self._obj = None
+        self.shm_ref = shm_ref
+
+    @property
+    def data(self) -> bytes:
+        if self._data is None:
+            self._data = bytes(self._source())
+        return self._data
+
+    def _decoded(self) -> Any:
+        if self._obj is None:
+            self._obj = unpack(self.data)
+        return self._obj
+
+    def __getitem__(self, key):
+        return self._decoded()[key]
+
+    def __contains__(self, key):
+        return key in self._decoded()
+
+    def get(self, key, default=None):
+        return self._decoded().get(key, default)
 
 
 def pack(obj: Any) -> bytes:

@@ -44,6 +44,12 @@ def kv_shard_parser() -> argparse.ArgumentParser:
         "requests carrying a different epoch are rejected — "
         "rpc/fencing.py)",
     )
+    p.add_argument(
+        "--shm_scope", default="",
+        help="shm-tier segment namespace for this shard slot (stable "
+        "across relaunches within a job; keys boot-time segment "
+        "reclamation — rpc/transport.ShmServer)",
+    )
     return p
 
 
@@ -76,7 +82,12 @@ def main(argv=None) -> int:
     servicer = KVShardServicer(
         args.shard_id, args.num_shards, generation=args.generation
     )
-    server = RpcServer(servicer.handlers(), port=args.port)
+    server = RpcServer(
+        servicer.handlers(),
+        port=args.port,
+        shm_scope=args.shm_scope or None,
+        shm_generation=args.generation,
+    )
     servicer.attach_admission_stats(server.admission_stats)
     server.start()
     logger.info(

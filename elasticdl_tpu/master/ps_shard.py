@@ -152,6 +152,24 @@ class PSShardServicer:
         # combine observability: ratio = combined_reports / batches
         self._combined_batches = 0
         self._combined_reports = 0
+        # pull prepack cache: one encoded {"version", "vec"} frame per
+        # (version, wire form), built OUTSIDE self._lock and served to
+        # every concurrent puller until the version bumps — model-down
+        # cost is one encode per version instead of one per puller, and
+        # pullers never serialize against push appliers on the shard
+        # lock. Guarded by its own lock: the cache must be consultable
+        # while an apply holds self._lock.
+        self._prepack_lock = threading.Lock()
+        self._prepack: Dict[Tuple[int, str], messages.Prepacked] = {}
+        self._prepack_encodes = 0
+        self._prepack_served = 0
+        self._prepack_copy_bytes = 0
+        # shm broadcast publisher (rpc/server.RpcServer.shm_broadcaster),
+        # attached like the wire stats; when present, prepacked pull
+        # frames are published once into a per-version read-only
+        # segment every co-located client maps — N pulls, one encode,
+        # zero payload copies
+        self._shm_pub = None
 
     # -- handler table -------------------------------------------------------
 
@@ -220,16 +238,103 @@ class PSShardServicer:
                 )
             return {"version": self._version, "size": self._vec.size}
 
-    def pull(self, req: dict) -> dict:
+    def pull(self, req: dict):
+        """Model-down for this slice. The lock is held only to snapshot
+        (version, vec reference); the encode happens OUTSIDE it via the
+        per-(version, wire-form) prepack cache, so a fleet of pullers
+        costs one encode per version and never serializes push
+        appliers. Returns the response dict for the metadata-only
+        answers and a `messages.Prepacked` frame (byte-identical to
+        packing the dict) for model-carrying ones."""
         self._check_epoch(req)
         with self._lock:
-            if self._vec is None:
-                return {"version": -1, "vec": None}
-            if req.get("only_if_newer") and self._version <= req.get(
-                "version", -1
-            ):
-                return {"version": self._version, "vec": None}
-            return {"version": self._version, "vec": self._wire_vec(req)}
+            vec = self._vec
+            version = self._version
+        if vec is None:
+            return {"version": -1, "vec": None}
+        if req.get("only_if_newer") and version <= req.get("version", -1):
+            return {"version": version, "vec": None}
+        return self._pull_prepacked(
+            version, vec, req.get("model_dtype") or "float32"
+        )
+
+    def _pull_prepacked(
+        self, version: int, vec: np.ndarray, form: str
+    ) -> messages.Prepacked:
+        key = (version, form)
+        with self._prepack_lock:
+            entry = self._prepack.get(key)
+            if entry is not None:
+                self._prepack_served += 1
+                return entry
+        # encode outside BOTH locks. push_delta mutates self._vec in
+        # place, so an unlocked read can tear — but every in-place
+        # mutation bumps self._version inside the same critical
+        # section, so re-checking the version after the encode detects
+        # any possible tear; serving the re-snapshotted NEWER version
+        # is always valid for pull.
+        for _ in range(3):
+            before = codec.encode_copy_stats()["bytes"]
+            entry = self._encode_pull_entry(version, vec, form)
+            copied = codec.encode_copy_stats()["bytes"] - before
+            with self._lock:
+                if self._version == version:
+                    break
+                version = self._version
+                vec = self._vec
+        else:
+            # the shard is bumping faster than we can encode: fall back
+            # to a private snapshot (copy under the lock — the only
+            # pull path that pays a lock-held copy, and only under
+            # pathological churn) and encode that
+            with self._lock:
+                version = self._version
+                vec = self._vec.copy()
+            before = codec.encode_copy_stats()["bytes"]
+            entry = self._encode_pull_entry(version, vec, form)
+            copied = codec.encode_copy_stats()["bytes"] - before
+        key = (version, form)
+        with self._prepack_lock:
+            cur = self._prepack.get(key)
+            if cur is not None:
+                self._prepack_served += 1
+                return cur
+            self._prepack_encodes += 1
+            self._prepack_copy_bytes += copied
+            self._prepack_served += 1
+            # version-bump invalidation: keep only the newest version's
+            # forms (the cache never grows past the handful of wire
+            # forms in use)
+            newest = max(k[0] for k in self._prepack) if self._prepack else -1
+            newest = max(newest, version)
+            for k in list(self._prepack):
+                if k[0] < newest:
+                    del self._prepack[k]
+            if version == newest:
+                self._prepack[key] = entry
+        return entry
+
+    def _encode_pull_entry(
+        self, version: int, vec: np.ndarray, form: str
+    ) -> messages.Prepacked:
+        """One pull frame for (version, form). f32 packs the live slice
+        directly (zero-copy into the frame / broadcast segment — the
+        caller's version recheck covers the unlocked read); other wire
+        forms pay their dtype conversion once per version. With the shm
+        publisher attached the frame is written straight into a
+        broadcast segment and the Prepacked carries its descriptor; the
+        frame bytes for non-shm tiers materialize lazily from the
+        mapped view."""
+        arr = vec if form == "float32" else vec.astype(codec.dtype_from_str(form))
+        obj = {"version": version, "vec": arr}
+        if self._shm_pub is not None:
+            pub = self._shm_pub.publish(obj)
+            if pub is not None:
+                ref, view = pub
+                return messages.Prepacked(
+                    source=lambda v=view: v, shm_ref=ref
+                )
+        return messages.Prepacked(messages.pack(obj))
 
     def push_grad(self, req: dict) -> dict:
         """Per-step gradient slice. Async mode applies immediately
@@ -515,6 +620,12 @@ class PSShardServicer:
         attach_wire_stats."""
         self._admission_fn = fn
 
+    def attach_shm_publisher(self, pub):
+        """Point pull prepacking at the hosting RpcServer's shm
+        broadcast publisher (RpcServer.shm_broadcaster), same contract
+        as attach_wire_stats; pass None when the shm tier is off."""
+        self._shm_pub = pub
+
     def stats(self) -> Dict[str, int]:
         """Push accounting (exactness evidence for the chaos tests):
         `applied_pushes` counts pushes that mutated state,
@@ -534,6 +645,14 @@ class PSShardServicer:
                 "combined_batches": self._combined_batches,
                 "combined_reports": self._combined_reports,
             }
+        with self._prepack_lock:
+            # pull amortization evidence: served / encodes is the
+            # pulls-per-encode ratio the prepack cache buys; copy_bytes
+            # is codec-counted compaction bytes on the encode path
+            # (0 == the zero-copy contract held)
+            out["prepack_encodes"] = self._prepack_encodes
+            out["prepack_served_pulls"] = self._prepack_served
+            out["prepack_encode_copy_bytes"] = self._prepack_copy_bytes
         if self._wire is not None:
             snap = self._wire.snapshot()
             out["bytes_sent"] = snap["bytes_sent"]

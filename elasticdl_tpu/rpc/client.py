@@ -129,3 +129,7 @@ class RpcClient:
 
     def close(self):
         self._channel.close()
+        # the shm transport holds pooled connections + broadcast
+        # mappings; other tiers have no client-side resources
+        if self._transport is not None and hasattr(self._transport, "close"):
+            self._transport.close()

@@ -7,15 +7,16 @@ format supports bf16 and nested pytrees (see common/codec.py).
 
 Every server also serves the transport fast paths (rpc/transport.py):
 its handler table is registered in the in-process dispatch registry
-keyed by the bound port, and — when `EDL_TRANSPORT` enables it — a
-Unix-domain-socket listener shares the same `ServerDispatcher`, so
-chaos/fencing/abort classification is identical on every tier.
+keyed by the bound port, and — when `EDL_TRANSPORT` enables them — a
+Unix-domain-socket listener and/or a shared-memory listener share the
+same `ServerDispatcher`, so chaos/fencing/abort classification is
+identical on every tier.
 """
 
 from __future__ import annotations
 
 from concurrent import futures
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 import grpc
 
@@ -58,6 +59,8 @@ class RpcServer:
         service_name: str = SERVICE_NAME,
         max_workers: int = 64,
         fault_plan=None,
+        shm_scope: Optional[str] = None,
+        shm_generation: int = 0,
     ):
         # server-side wire-byte accounting (payload bytes per method);
         # surfaced via `wire_stats()` and shard `stats()` RPCs
@@ -111,11 +114,38 @@ class RpcServer:
                     self.port,
                     e,
                 )
+        self._shm = None
+        if transport_mod.server_shm_enabled():
+            # one ShmServer class for both dispatch cores: under loop
+            # dispatch the conn thread parks on the reactor shim, like
+            # a grpc pool thread (rpc/transport.ShmServer docstring)
+            try:
+                self._shm = transport_mod.ShmServer(
+                    self.port,
+                    self._dispatcher,
+                    scope=shm_scope,
+                    generation=shm_generation,
+                )
+            except OSError as e:
+                logger.warning(
+                    "shm fast path unavailable for port %s (%s)",
+                    self.port,
+                    e,
+                )
+
+    @property
+    def shm_broadcaster(self):
+        """The shm tier's broadcast publisher, or None when the tier is
+        inactive; PSShard attaches this to publish prepacked pull
+        frames as per-version broadcast segments."""
+        return self._shm.broadcaster if self._shm is not None else None
 
     def start(self):
         self._server.start()
         if self._uds is not None:
             self._uds.start()
+        if self._shm is not None:
+            self._shm.start()
 
     def wire_stats(self) -> dict:
         """Per-method bytes_sent/bytes_received snapshot (see
@@ -134,6 +164,8 @@ class RpcServer:
         transport_mod.unregister_inproc(self.port)
         if self._uds is not None:
             self._uds.close()
+        if self._shm is not None:
+            self._shm.close()
         self._server.stop(grace)
         self._dispatcher.close()
 

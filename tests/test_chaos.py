@@ -577,6 +577,132 @@ def test_chaos_exact_accounting_over_uds_tier(tmp_path, monkeypatch):
 
 @pytest.mark.e2e
 @pytest.mark.chaos
+def test_chaos_exact_accounting_over_shm_tier(tmp_path, monkeypatch):
+    """The acceptance run over the shared-memory ring tier
+    (EDL_TRANSPORT=shm inherits into the spawned workers; the
+    rendezvous files live in the pinned EDL_UDS_DIR). Faults inject at
+    the shm framing layer through the SAME transport_faults_before/
+    after hooks as the uds tier, and the bar is the same absolute one:
+    every record exactly once, dedup absorbing the drop-retry, shard
+    versions landing at [16, 16]. Also asserts the job left no orphan
+    ring segments behind — teardown is part of the tier's contract."""
+    from elasticdl_tpu.common.constants import ENV_TRANSPORT, ENV_UDS_DIR
+    from elasticdl_tpu.testing import write_linear_records
+
+    tmp = str(tmp_path)
+    for i in range(2):
+        write_linear_records(
+            os.path.join(tmp, f"shard-{i}.rio"), 64, seed=i, noise=0.05
+        )
+    monkeypatch.setenv(ENV_TRANSPORT, "shm")
+    monkeypatch.setenv(ENV_UDS_DIR, tmp)
+    chaos_spec = {
+        "seed": 11,
+        "faults": [
+            {"kind": "error", "code": "UNAVAILABLE",
+             "methods": ["PSPushGrad"], "roles": ["worker"], "every": 4,
+             "max_fires": 3},
+            {"kind": "drop", "methods": ["PSPushGrad"], "roles": ["worker"],
+             "nth": 3},
+            {"kind": "crash", "methods": ["GetTask"], "roles": ["worker"],
+             "targets": ["0"], "nth": 2, "when": "after",
+             "once_file": os.path.join(tmp, "crash.once")},
+        ],
+    }
+    result = _run_training_job(tmp, "shm-chaos", monkeypatch, chaos_spec)
+    # exact accounting: identical absolute numbers to the fault-free
+    # gRPC baseline in test_chaos_training_job_exact_accounting
+    assert result["completed_records"] == 256
+    assert result["versions"] == [16, 16]
+    assert result["applied"] == 32
+    assert result["duplicates"] >= 1, "no drop-retry was deduped"
+    assert result["relaunches"] >= 1
+    assert abs(result["kernel"] - 2.0) < 0.6, result["kernel"]
+    # the ring tier actually carried the job: worker calls over shm,
+    # none over grpc or uds (no silent fallback to a socket path)
+    tiers = result["server_transports"]
+    assert tiers.get("shm", {}).get("calls", 0) > 0, tiers
+    assert tiers.get("grpc", {}).get("calls", 0) == 0, tiers
+    assert tiers.get("uds", {}).get("calls", 0) == 0, tiers
+    # teardown left no ring segments or rendezvous files behind
+    assert not [
+        f for f in os.listdir("/dev/shm") if f.startswith("edlshm.")
+    ]
+    assert not [
+        f for f in os.listdir(tmp)
+        if f.startswith("edl-shm-") and f.endswith(".json")
+    ]
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+def test_shm_sigkill_shard_leaves_no_orphan_segments(tmp_path, monkeypatch):
+    """Stale-ring reclamation, end to end: SIGKILL a PS shard
+    subprocess serving over shm (no atexit, no finally — the kernel
+    keeps its segments and rendezvous file alive), relaunch the slot at
+    a bumped fencing generation, and assert the successor's boot sweep
+    removed every dead-generation segment. The group teardown must then
+    leave /dev/shm and the rendezvous dir empty."""
+    import signal
+
+    from elasticdl_tpu.common.constants import ENV_TRANSPORT, ENV_UDS_DIR
+    from elasticdl_tpu.master.ps_group import PSShardGroup
+
+    monkeypatch.setenv(ENV_TRANSPORT, "shm")
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+    group = PSShardGroup(
+        2,
+        mode="process",
+        shard_argv=[
+            "--model_zoo", FIXTURES,
+            "--model_def", "linear_module.custom_model",
+            "--minibatch_size", "16",
+        ],
+        use_async=True,
+    )
+    group.start()
+    try:
+        vec = np.arange(2048, dtype=np.float32)
+        group.ensure_init(vec)
+        versions, got = group.client().pull()
+        np.testing.assert_array_equal(got, vec)
+        live = [
+            f for f in os.listdir("/dev/shm") if f.startswith("edlshm.")
+        ]
+        assert any(".ps0.g0." in s for s in live), live
+
+        pid = group._procs[0].pid
+        os.kill(pid, signal.SIGKILL)
+        group._procs[0].wait()
+        group.relaunch_shard(0)  # generation 0 -> 1
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            orphans = [
+                f
+                for f in os.listdir("/dev/shm")
+                if f.startswith("edlshm.") and ".ps0.g0." in f
+            ]
+            if not orphans:
+                break
+            time.sleep(0.05)
+        assert not orphans, f"dead-generation segments survived: {orphans}"
+        # the relaunched (empty) slot re-inits and serves over shm again
+        group.ensure_init(vec)
+        versions, _got = group.client().pull()
+        assert len(versions) == 2
+    finally:
+        group.stop()
+    assert not [
+        f for f in os.listdir("/dev/shm") if f.startswith("edlshm.")
+    ]
+    assert not [
+        f for f in os.listdir(str(tmp_path))
+        if f.startswith("edl-shm-") and f.endswith(".json")
+    ]
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
 @pytest.mark.slow
 def test_chaos_stress_high_fault_rate(tmp_path, monkeypatch):
     """Long stress variant (excluded from the default tier via the

@@ -66,6 +66,54 @@ def test_fanin_smoke_suite_json_contract():
 
 
 @pytest.mark.e2e
+@pytest.mark.perf
+def test_fanin_smoke_n8_shm_beats_uds():
+    """The shm-tier acceptance cell: at N=8 on the loop+combine core,
+    the shared-memory ring tier must beat the uds socket tier on BOTH
+    sustained reports/sec and p99 push latency — the frames are
+    identical, so the delta is purely the transport (ring write + one
+    doorbell wake vs full socket framing with a kernel copy each way).
+    Best-of-3 per tier: these are short windows on a shared CI host,
+    and one descheduled wake must not fail the contract. The shm cells
+    must also show zero grpc/uds bytes (no silent fallback), and the
+    prepacked pull path must hold its zero-copy counters."""
+    def best(tier):
+        cells = [
+            run_cell(
+                8, tier, dispatch="loop", combine=True, wire="topk",
+                warmup_s=0.3, window_s=1.0,
+            )
+            for _ in range(3)
+        ]
+        for c in cells:
+            assert c["version"] == c["applied_pushes"] > 0
+        rps = max(c["reports_per_sec"] for c in cells)
+        p99s = [c["p99_ms"] for c in cells if c["p99_ms"] is not None]
+        return rps, (min(p99s) if p99s else None), cells
+
+    uds_rps, uds_p99, _uds_cells = best("uds")
+    shm_rps, shm_p99, shm_cells = best("shm")
+    assert shm_rps > uds_rps, (shm_rps, uds_rps)
+    assert shm_p99 is not None and uds_p99 is not None
+    assert shm_p99 < uds_p99, (shm_p99, uds_p99)
+    for c in shm_cells:
+        tr = c["server_transports"]
+        assert tr.get("shm", {}).get("calls", 0) > 0, tr
+        for socket_tier in ("grpc", "uds"):
+            row = tr.get(socket_tier, {})
+            assert (
+                row.get("bytes_sent", 0) + row.get("bytes_received", 0)
+            ) == 0, (socket_tier, tr)
+    # zero-copy counters on the model-down path (the tentpole's other
+    # half): 8 pullers served from one broadcast-published encode
+    from bench import _pull_fanout_cell
+
+    cell = _pull_fanout_cell("shm")
+    assert cell["prepack_encode_copy_bytes"] == 0
+    assert cell["pulls_served_per_encode"] >= 8
+
+
+@pytest.mark.e2e
 @pytest.mark.slow
 def test_fanin_stress_n64_loop_combine_exact():
     """N=64 closed-loop pushers through the loop core with combining:

@@ -558,6 +558,165 @@ def test_process_mode_shard_group(tmp_path):
         group.stop()
 
 
+# -- pull prepack cache (model-down broadcast) --------------------------------
+
+
+def test_pull_prepack_one_encode_serves_fleet():
+    """N pulls of one version cost ONE encode; the cached Prepacked
+    frame duck-types as the response dict for direct callers."""
+    shard = PSShardServicer(0, 1)
+    vec = np.arange(64, dtype=np.float32)
+    shard.init_slice({"vec": vec, "version": 0})
+    for _ in range(8):
+        got = shard.pull({})
+        assert got["version"] == 0
+        np.testing.assert_array_equal(got["vec"], vec)
+    stats = shard.stats()
+    assert stats["prepack_encodes"] == 1
+    assert stats["prepack_served_pulls"] == 8
+    assert stats["prepack_served_pulls"] // stats["prepack_encodes"] >= 8
+
+
+def test_pull_prepack_version_bump_invalidates():
+    """A push evicts the stale version's frames; the next pull encodes
+    the new version once and serves it thereafter."""
+    shard = PSShardServicer(0, 1)
+    vec = np.arange(16, dtype=np.float32)
+    shard.init_slice({"vec": vec, "version": 0})
+    shard.pull({})
+    shard.push_delta(
+        {"delta": np.ones(16, np.float32), "steps": 1, "base_version": 0}
+    )
+    for _ in range(3):
+        got = shard.pull({})
+        assert got["version"] == 1
+        np.testing.assert_array_equal(got["vec"], vec + 1.0)
+    stats = shard.stats()
+    assert stats["prepack_encodes"] == 2  # v0 once, v1 once
+    assert stats["prepack_served_pulls"] == 4
+
+
+def test_pull_prepack_caches_wire_forms_separately():
+    """model_dtype selects the wire form; each (version, form) pair is
+    its own cache entry, so mixed-dtype fleets don't thrash."""
+    shard = PSShardServicer(0, 1)
+    vec = np.arange(32, dtype=np.float32)
+    shard.init_slice({"vec": vec, "version": 0})
+    for _ in range(2):
+        f32 = shard.pull({})
+        bf16 = shard.pull({"model_dtype": "bfloat16"})
+        np.testing.assert_array_equal(f32["vec"], vec)
+        np.testing.assert_allclose(bf16["vec"], vec, rtol=0.01)
+    stats = shard.stats()
+    assert stats["prepack_encodes"] == 2
+    assert stats["prepack_served_pulls"] == 4
+
+
+def test_pull_encode_runs_outside_shard_lock():
+    """Lock-discipline regression (the hoist this cache exists for): a
+    slow pull encode must NOT serialize push appliers on the shard
+    lock. A patched encoder blocks mid-encode until a concurrent
+    push_delta completes; if the encode held self._lock the push could
+    never finish and the flag would stay False. The version bump also
+    forces the encoder's re-check loop, so the pull must come back with
+    the POST-push version — the tear detection observed the mutation."""
+    import threading
+
+    from elasticdl_tpu.common import messages as messages_mod
+
+    shard = PSShardServicer(0, 1)
+    vec = np.zeros(32, np.float32)
+    shard.init_slice({"vec": vec, "version": 0})
+
+    in_encode = threading.Event()
+    push_done = threading.Event()
+    real_pack = messages_mod.pack
+    blocked_once = []
+
+    def slow_pack(obj):
+        if not blocked_once and isinstance(obj, dict) and "vec" in obj:
+            blocked_once.append(True)
+            in_encode.set()
+            push_done.wait(timeout=10)
+        return real_pack(obj)
+
+    result = {}
+
+    def puller():
+        result["resp"] = shard.pull({})
+
+    messages_mod.pack = slow_pack
+    try:
+        t = threading.Thread(target=puller)
+        t.start()
+        assert in_encode.wait(timeout=10), "pull never reached the encoder"
+        # the push must proceed WHILE the encode is blocked: it needs
+        # self._lock, which a hoisted encode does not hold
+        shard.push_delta(
+            {"delta": np.ones(32, np.float32), "steps": 1, "base_version": 0}
+        )
+        push_done.set()
+        t.join(timeout=10)
+        assert not t.is_alive(), "pull deadlocked against push"
+    finally:
+        messages_mod.pack = real_pack
+        push_done.set()
+    # the re-check loop saw the bump and re-encoded the newer version
+    assert result["resp"]["version"] == 1
+    np.testing.assert_array_equal(result["resp"]["vec"], np.ones(32))
+
+
+def test_pull_prepack_shm_broadcast_views_survive_server_close():
+    """Over the shm tier a pull resolves to a view over the broadcast
+    segment. A client that already resolved a frame must be able to
+    keep READING it after the server closes (Linux keeps unlinked
+    mappings alive until the last map drops) — only new calls fail."""
+    import tempfile
+
+    from elasticdl_tpu.common.constants import ENV_TRANSPORT, ENV_UDS_DIR
+    from elasticdl_tpu.rpc.client import RpcClient
+    from elasticdl_tpu.rpc.server import RpcServer
+
+    tmp = tempfile.mkdtemp()
+    prev = {
+        k: os.environ.get(k) for k in (ENV_TRANSPORT, ENV_UDS_DIR)
+    }
+    os.environ[ENV_TRANSPORT] = "shm"
+    os.environ[ENV_UDS_DIR] = tmp
+    try:
+        shard = PSShardServicer(0, 1)
+        server = RpcServer(
+            shard.handlers(), port=0, shm_scope="tt.bcast", shm_generation=0
+        )
+        shard.attach_wire_stats(server.wire)
+        shard.attach_shm_publisher(server.shm_broadcaster)
+        server.start()
+        client = RpcClient(f"localhost:{server.port}")
+        try:
+            vec = np.arange(1024, dtype=np.float32)
+            client.call("PSInit", {"vec": vec, "version": 0})
+            got = client.call("PSPull", {})
+            np.testing.assert_array_equal(got["vec"], vec)
+            stats = shard.stats()
+            assert stats["prepack_encodes"] == 1
+            assert stats["prepack_encode_copy_bytes"] == 0
+            server.stop()
+            # the already-decoded response stays readable post-close
+            np.testing.assert_array_equal(got["vec"], vec)
+        finally:
+            client.close()
+            server.stop()
+        assert not [
+            f for f in os.listdir("/dev/shm") if ".tt.bcast." in f
+        ]
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def test_reset_local_state_clears_shard_versions():
     """ADVICE r3 (high): after a failed sync the sharded pull must be
     unconditional — a surviving per-shard version vector would let

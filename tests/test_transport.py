@@ -1,13 +1,16 @@
-"""Transport-tier tests: the inproc/UDS fast paths under the gRPC call
-surface (rpc/transport.py).
+"""Transport-tier tests: the inproc/UDS/shm fast paths under the gRPC
+call surface (rpc/transport.py).
 
 Covers tier selection (conservative fallback to gRPC on any doubt),
 round-trips over every tier with the SAME failure semantics (fencing
 -> FAILED_PRECONDITION, handler bugs -> INTERNAL with sanitized
 detail, unknown method -> UNIMPLEMENTED), chaos FaultPlan injection on
-the fast paths, and the WireStats transport dimension: per-endpoint
-bytes summing correctly across mixed tiers, inproc calls counted with
-ZERO wire bytes.
+the fast paths, the WireStats transport dimension (per-endpoint bytes
+summing correctly across mixed tiers, inproc calls counted with ZERO
+wire bytes), and the shm ring edge cases: frames larger than the ring
+chunk through it, concurrent clients keep frames paired, a closed
+server severs pooled clients, and boot-time reclamation sweeps a dead
+predecessor's segments and rendezvous files.
 """
 
 import os
@@ -61,6 +64,12 @@ def uds_env(monkeypatch, tmp_path):
 @pytest.fixture
 def inproc_env(monkeypatch):
     monkeypatch.setenv(ENV_TRANSPORT, "inproc")
+
+
+@pytest.fixture
+def shm_env(monkeypatch, tmp_path):
+    monkeypatch.setenv(ENV_TRANSPORT, "shm")
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
 
 
 # -- tier selection -----------------------------------------------------------
@@ -140,7 +149,7 @@ def _roundtrip(client):
     )
 
 
-@pytest.mark.parametrize("env_fixture", ["uds_env", "inproc_env"])
+@pytest.mark.parametrize("env_fixture", ["uds_env", "inproc_env", "shm_env"])
 def test_fast_tier_roundtrip_and_errors(env_fixture, request):
     """Echo round-trip plus the three failure classifications, on each
     fast tier — byte-identical semantics to the gRPC tier."""
@@ -464,3 +473,262 @@ def test_uds_path_rendezvous_is_port_keyed(monkeypatch, tmp_path):
     monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
     assert transport.uds_path_for(50051) == transport.uds_path_for(50051)
     assert transport.uds_path_for(50051) != transport.uds_path_for(50052)
+
+
+# -- shm tier -----------------------------------------------------------------
+
+
+def _no_shm_segments(scope_fragment: str) -> bool:
+    return not any(
+        scope_fragment in f
+        for f in os.listdir("/dev/shm")
+        if f.startswith("edlshm.")
+    )
+
+
+def test_transport_tiers_registry():
+    """The tier registry is the single source the lint rules, docs and
+    benches enumerate — adding a tier without registering it here is
+    the drift the static-analysis suite exists to catch."""
+    assert transport.TRANSPORT_TIERS == (
+        transport.TRANSPORT_GRPC,
+        transport.TRANSPORT_UDS,
+        transport.TRANSPORT_SHM,
+        transport.TRANSPORT_INPROC,
+    )
+    assert transport.TRANSPORT_SHM == "shm"
+
+
+def test_shm_select_without_rendezvous_falls_back(monkeypatch, tmp_path):
+    """EDL_TRANSPORT=shm with no rendezvous file for the port: the
+    conservative contract — fall back to gRPC, never attach blind."""
+    monkeypatch.setenv(ENV_TRANSPORT, "shm")
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+    assert transport.select_transport("localhost:45997") is None
+
+
+def test_shm_rendezvous_embeds_scope_and_generation(shm_env):
+    """The port-keyed rendezvous file carries the fencing generation
+    and segment prefix a client needs to attach the RIGHT incarnation's
+    rings (satellite: generation-keyed rendezvous)."""
+    server = RpcServer(
+        _echo_handlers(), port=0, shm_scope="tt.ps0", shm_generation=3
+    )
+    server.start()
+    try:
+        info = transport.read_shm_rendezvous(server.port)
+        assert info is not None
+        assert info["scope"] == "tt.ps0"
+        assert info["generation"] == 3
+        assert info["prefix"] == "edlshm.tt.ps0.g3."
+        assert os.path.exists(info["doorbell"])
+        # and a client attaching through it lands on the shm tier
+        client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+        try:
+            assert client._transport is not None
+            assert client._transport.name == "shm"
+            _roundtrip(client)
+        finally:
+            client.close()
+    finally:
+        server.stop()
+    assert _no_shm_segments(".tt.ps0.")
+    assert transport.read_shm_rendezvous(server.port) is None
+
+
+def test_shm_server_gone_is_unavailable(shm_env):
+    """close() severs pooled client connections: the next call fails
+    like a stopped gRPC server, never hangs on a dead ring."""
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        _roundtrip(client)
+        server.stop()
+        with pytest.raises(grpc.RpcError) as ei:
+            client.call("Echo", {"x": 1}, timeout=1)
+        assert ei.value.code() in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+        )
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_shm_concurrent_calls_keep_frames_paired(shm_env):
+    """Pipelined overlapping calls on one pooled client: each response
+    ring must answer the request that rode its own connection."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futs = [
+                pool.submit(client.call, "Echo", {"x": i}, 30)
+                for i in range(32)
+            ]
+            got = sorted(f.result()["x"] for f in futs)
+        assert got == list(range(32))
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_shm_frame_larger_than_ring_is_chunked(shm_env, monkeypatch):
+    """A frame bigger than the ring must chunk through it intact, both
+    directions — the fallback that keeps tiny-ring configs correct."""
+    from elasticdl_tpu.common.constants import ENV_TRANSPORT_SHM_RING
+
+    monkeypatch.setenv(ENV_TRANSPORT_SHM_RING, "8192")
+    assert transport.shm_ring_bytes() == 8192
+    vec = np.random.default_rng(5).standard_normal(1 << 15).astype(np.float32)
+
+    def big(req):
+        np.testing.assert_array_equal(req["v"], vec)
+        return {"v": req["v"] * 2}
+
+    server = RpcServer({"Big": big}, port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        assert client._transport is not None
+        assert client._transport.name == "shm"
+        resp = client.call("Big", {"v": vec}, timeout=30)
+        np.testing.assert_allclose(resp["v"], vec * 2)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_shm_loop_dispatch_roundtrip(shm_env, monkeypatch):
+    """The shm listener serves the event-loop core through the same
+    reactor shim as grpc pool threads — both EDL_DISPATCH cores answer
+    over the ring."""
+    from elasticdl_tpu.common.constants import ENV_DISPATCH
+
+    monkeypatch.setenv(ENV_DISPATCH, "loop")
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        assert client._transport is not None
+        assert client._transport.name == "shm"
+        _roundtrip(client)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_shm_client_error_injection_retried(shm_env):
+    """Chaos parity: the FaultPlan hooks fire at the shm framing layer
+    exactly like the uds tier — an injected client-side error never
+    reaches the server and the policy retry lands."""
+    hits = []
+    server = RpcServer(_echo_handlers(hits), port=0)
+    server.start()
+    plan = FaultPlan.from_spec(
+        {"faults": [{"kind": "error", "methods": ["Echo"], "nth": 1}]}
+    )
+    client = RpcClient(
+        f"localhost:{server.port}", policy=fast_policy(), fault_plan=plan
+    )
+    try:
+        assert client._transport is not None and client._transport.name == "shm"
+        assert client.call("Echo", {"x": 1}, timeout=10, idempotent=True)[
+            "x"
+        ] == 1
+        assert hits == [1], "injected attempt must never reach the server"
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_shm_drop_applies_then_retry_reaches_server(shm_env):
+    hits = []
+    server = RpcServer(_echo_handlers(hits), port=0)
+    server.start()
+    plan = FaultPlan.from_spec(
+        {"faults": [{"kind": "drop", "methods": ["Echo"], "nth": 1}]}
+    )
+    client = RpcClient(
+        f"localhost:{server.port}", policy=fast_policy(), fault_plan=plan
+    )
+    try:
+        assert client.call("Echo", {"x": 7}, timeout=10, idempotent=True)[
+            "x"
+        ] == 7
+        assert hits == [7, 7]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_shm_wire_stats_no_socket_bytes(shm_env):
+    """The tier-labeled accounting: all payload bytes land under "shm",
+    none under grpc or uds (the doorbell carries only handshakes, which
+    WireStats never counts), and the server mirrors the client."""
+    server = RpcServer(_echo_handlers(), port=0)
+    server.start()
+    client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+    try:
+        client.wire.reset()
+        _roundtrip(client)
+        snap = client.wire.snapshot()
+        assert list(snap["transports"]) == ["shm"]
+        row = snap["transports"]["shm"]
+        assert row["bytes_sent"] > 0 and row["bytes_received"] > 0
+        srv = server.wire.snapshot()["transports"]
+        assert set(srv) == {"shm"}
+        assert srv["shm"]["bytes_received"] == row["bytes_sent"]
+        assert srv["shm"]["bytes_sent"] == row["bytes_received"]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_shm_boot_reclaims_dead_predecessor(shm_env):
+    """A SIGKILLed incarnation leaves segments + a rendezvous file with
+    no owner. Booting the successor (same scope, bumped generation)
+    must sweep all of it BEFORE binding — satellite: stale-ring
+    reclamation. Covers both sweep keys: same-port rendezvous and
+    same-scope older-generation rendezvous parked on another port."""
+    scope = "tt.reclaim0"
+    # fabricate the dead incarnation's leavings: one ring segment, one
+    # same-scope g0 rendezvous on a DIFFERENT port, pointing at it
+    dead = transport._create_shm_segment(f"edlshm.{scope}.g0.c1", 4096)
+    dead.close()
+    other_port = 45901
+    with open(transport.shm_rendezvous_path(other_port), "w") as f:
+        import json as _json
+
+        _json.dump(
+            {
+                "scope": scope,
+                "generation": 0,
+                "prefix": f"edlshm.{scope}.g0.",
+                "doorbell": transport.shm_doorbell_path(other_port),
+                "ring": 4096,
+                "pid": 0,
+            },
+            f,
+        )
+    assert not _no_shm_segments(f".{scope}.")
+    server = RpcServer(
+        _echo_handlers(), port=0, shm_scope=scope, shm_generation=1
+    )
+    server.start()
+    try:
+        # the g0 orphan and the stale rendezvous are gone; g1 serves
+        assert _no_shm_segments(f".{scope}.g0.")
+        assert transport.read_shm_rendezvous(other_port) is None
+        client = RpcClient(f"localhost:{server.port}", policy=fast_policy())
+        try:
+            _roundtrip(client)
+        finally:
+            client.close()
+    finally:
+        server.stop()
+    assert _no_shm_segments(f".{scope}.")
