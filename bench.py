@@ -717,6 +717,62 @@ def main():
         file=sys.stderr,
     )
 
+    # ---- span-derived sync critical path (obs/critical_path.py) ----
+    # a short traced re-run of the window job: EDL_TRACE_SAMPLE=1 for
+    # exactly this job, recorder cleared first so the breakdown sees
+    # one job's spans. The sum_fraction gate is the honesty check: the
+    # named components must re-compose the independently span-measured
+    # sync chain wall to within 10%, or a hop joined the chain without
+    # instrumentation (or got double-billed).
+    from elasticdl_tpu.common.constants import ENV_TRACE_SAMPLE
+    from elasticdl_tpu.obs import trace as obs_trace
+    from elasticdl_tpu.obs.critical_path import sync_critical_path_from_spans
+
+    prev_sample = os.environ.get(ENV_TRACE_SAMPLE)
+    os.environ[ENV_TRACE_SAMPLE] = "1"
+    obs_trace.refresh()
+    obs_trace.RECORDER.clear()
+    try:
+        run_job(
+            model_module,
+            path,
+            2048,
+            minibatch=minibatch,
+            records_per_task=512,
+            epochs=1,
+            local_updates=4,
+            grads_to_wait=1,
+            sync_dtype="bfloat16",
+        )
+    finally:
+        if prev_sample is None:
+            os.environ.pop(ENV_TRACE_SAMPLE, None)
+        else:
+            os.environ[ENV_TRACE_SAMPLE] = prev_sample
+        obs_trace.refresh()
+    critical_path = sync_critical_path_from_spans(
+        obs_trace.RECORDER.snapshot(), sync_method="ReportLocalUpdate"
+    )
+    assert critical_path is not None, (
+        "traced run recorded no worker.window_sync spans — the sync "
+        "chain lost its instrumentation (worker/worker.py)"
+    )
+    frac = critical_path["sum_fraction"]
+    assert frac is not None and 0.9 <= frac <= 1.1, (
+        f"critical-path components sum to {frac} of the span-measured "
+        f"sync wall (must be within 10%): {critical_path}"
+    )
+    print(
+        f"bench[critical path]: {critical_path['rounds']} rounds, "
+        f"sync_wait {critical_path['sync_wait_s']}s = "
+        f"encode {critical_path['encode_s']}s + "
+        f"queue {critical_path['queue_wait_s']}s + "
+        f"apply {critical_path['apply_s']}s + "
+        f"wire {critical_path['wire_s']}s "
+        f"(sum_fraction {frac})",
+        file=sys.stderr,
+    )
+
     # ---- north-star model: ResNet-50 chip throughput ----
     # (bench_resnet.py holds the full story incl. the elastic-runtime
     # number and the link physics; the chip number rides the driver's
@@ -794,6 +850,11 @@ def main():
         # acceptance run; this is the same protocol, short
         # windows)
         "fanin": fanin,
+        # span-derived sync critical path (EDL_TRACE_SAMPLE=1 re-run):
+        # where a sync round's wall time goes — encode / queue-wait /
+        # combine / apply / wire — gated on the components re-composing
+        # the span-measured sync wall within 10% (sum_fraction)
+        "sync_critical_path": critical_path,
         "resnet50_chip": resnet,
         "window_runs_images_per_sec": [
             round(a[0], 1) for a in attempts

@@ -80,7 +80,7 @@ def run(ctx: AnalysisContext) -> List[Finding]:
     g = CallGraph(ctx)
     handlers = _collect_handlers(ctx)
     roots = []
-    for h in handlers.values():
+    for h in (h for hs in handlers.values() for h in hs):
         if h.func is None:
             continue
         cls_name = h.cls.name if h.cls is not None else None

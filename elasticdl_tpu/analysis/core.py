@@ -16,6 +16,7 @@ Rule families (one module each):
 - ``lock-discipline``      (lock_discipline.py)
 - ``jit-purity``           (jit_purity.py)
 - ``env-registry``         (env_registry.py)
+- ``metric-registry``      (metric_registry.py)
 - ``fencing-conformance``  (fencing_conformance.py, interprocedural)
 - ``lock-order``           (lock_order.py, interprocedural)
 - ``abort-discipline``     (abort_discipline.py, interprocedural)
@@ -58,6 +59,7 @@ RULE_FAMILIES = (
     "lock-discipline",
     "jit-purity",
     "env-registry",
+    "metric-registry",
     "fencing-conformance",
     "lock-order",
     "abort-discipline",
@@ -294,6 +296,7 @@ def _rule_modules():
         jit_purity,
         lock_discipline,
         lock_order,
+        metric_registry,
         rpc_conformance,
     )
 
@@ -302,6 +305,7 @@ def _rule_modules():
         "lock-discipline": lock_discipline,
         "jit-purity": jit_purity,
         "env-registry": env_registry,
+        "metric-registry": metric_registry,
         "fencing-conformance": fencing_conformance,
         "lock-order": lock_order,
         "abort-discipline": abort_discipline,

@@ -18,6 +18,9 @@ string — ``os.environ.get(K)``, ``os.getenv(K)``, ``env[K]``,
 
 Literal keys are allowed but the constants are preferred; the point of
 the rule is that the registry stays complete, not how it's referenced.
+The observability knobs (``EDL_TRACE_*``/``EDL_METRICS_*``/
+``EDL_FLIGHT_*``) are checked by metric-registry instead, so each
+violation maps to exactly one family.
 """
 
 from __future__ import annotations
@@ -32,6 +35,10 @@ RULE = "env-registry"
 
 _PREFIX = re.compile(r"^(EDL_|K8S_)")
 _REGISTRY_NAME = "ENV_REGISTRY"
+
+#: observability knobs are owned by the metric-registry family
+#: (undeclared-obs-env) so a violation maps to exactly one rule
+_DELEGATED = re.compile(r"^(EDL_TRACE_|EDL_METRICS_|EDL_FLIGHT_)")
 
 
 def _module_str_consts(tree: ast.AST) -> Dict[str, str]:
@@ -138,6 +145,8 @@ def run(ctx: AnalysisContext) -> List[Finding]:
     for path, tree in ctx.trees():
         local_consts = _module_str_consts(tree)
         for var, line in _env_key_uses(tree, local_consts, global_consts):
+            if _DELEGATED.match(var):
+                continue
             if reg_path is None:
                 findings.append(
                     Finding(

@@ -286,7 +286,7 @@ def run(ctx: AnalysisContext) -> List[Finding]:
     cls_helpers: Dict[Tuple[str, str], Set[str]] = {}
     cls_unfenced: Dict[Tuple[str, str], Set[str]] = {}
     by_class: Dict[Tuple[str, str], List] = {}
-    for h in handlers.values():
+    for h in (h for hs in handlers.values() for h in hs):
         if h.cls is None or h.func is None:
             continue
         ckey = (h.path, h.cls.name)

@@ -1,0 +1,127 @@
+"""metric-registry: every emitted edl_* metric must be a declared name.
+
+``obs/metrics.py`` holds the registry::
+
+    METRIC_REGISTRY = {"edl_wire_bytes_sent_total": "help...", ...}
+
+The :class:`~elasticdl_tpu.obs.metrics.MetricsRegistry` already raises
+at runtime on an undeclared name, but only on code paths a test
+actually exercises; this rule proves the invariant statically for
+every emit site in the tree. An emit site is a call to one of the
+registry/sink emit methods — ``inc``, ``set_gauge``, ``counter``,
+``gauge`` — whose first argument resolves to an ``edl_``-prefixed
+string (a literal, or a name bound to one same-file or in the registry
+module). Checks:
+
+- ``undeclared-metric``: the emitted name is not a METRIC_REGISTRY key;
+- ``no-metric-registry``: no METRIC_REGISTRY dict exists in the tree
+  at all (emitted once, against the first emit site found);
+- ``undeclared-obs-env``: an ``EDL_TRACE_*``/``EDL_METRICS_*``/
+  ``EDL_FLIGHT_*`` env read is not declared in ENV_REGISTRY — the obs
+  plane's knobs are its contract with operators, so this rule owns
+  them explicitly (env-registry covers the generic EDL_* case).
+
+Only literal-resolvable names are checked: a computed metric name
+defeats the static proof AND the greppability the registry exists for,
+so keep names literal at emit sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set, Tuple
+
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+from elasticdl_tpu.analysis.env_registry import (
+    _env_key_uses,
+    _find_registry,
+    _module_str_consts,
+    _resolve_key,
+)
+
+RULE = "metric-registry"
+
+_METRIC_PREFIX = re.compile(r"^edl_")
+_OBS_ENV_PREFIX = re.compile(r"^(EDL_TRACE_|EDL_METRICS_|EDL_FLIGHT_)")
+_EMIT_METHODS = frozenset({"inc", "set_gauge", "counter", "gauge"})
+_REGISTRY_NAME = "METRIC_REGISTRY"
+
+
+def _find_metric_registry(
+    ctx: AnalysisContext,
+) -> Tuple[Optional[str], Set[str]]:
+    """(path of the module declaring METRIC_REGISTRY, declared names)."""
+    for path, tree in ctx.trees():
+        consts = _module_str_consts(tree)
+        for node in ast.walk(tree):
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                if isinstance(node.targets[0], ast.Name):
+                    target, value = node.targets[0].id, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    target, value = node.target.id, node.value
+            if target != _REGISTRY_NAME or not isinstance(value, ast.Dict):
+                continue
+            declared: Set[str] = set()
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    declared.add(k.value)
+                elif isinstance(k, ast.Name) and k.id in consts:
+                    declared.add(consts[k.id])
+            return path, declared
+    return None, set()
+
+
+def _metric_emits(tree: ast.AST, local_consts) -> List[Tuple[str, int]]:
+    """(metric name, line) for every emit-method call whose first arg
+    resolves to an edl_* string."""
+    emits: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _EMIT_METHODS):
+            continue
+        name = _resolve_key(node.args[0], local_consts, {})
+        if name is not None and _METRIC_PREFIX.match(name):
+            emits.append((name, node.lineno))
+    return emits
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    findings: List[Finding] = []
+    reg_path, declared = _find_metric_registry(ctx)
+    env_path, env_declared, global_consts = _find_registry(ctx)
+    for path, tree in ctx.trees():
+        local_consts = _module_str_consts(tree)
+        for name, line in _metric_emits(tree, local_consts):
+            if reg_path is None:
+                findings.append(
+                    Finding(
+                        RULE, "no-metric-registry", path, line,
+                        f"metric '{name}' emitted but no METRIC_REGISTRY "
+                        f"dict exists to declare it",
+                    )
+                )
+                return findings  # one finding is enough: fix the registry
+            if name not in declared:
+                findings.append(
+                    Finding(
+                        RULE, "undeclared-metric", path, line,
+                        f"metric '{name}' is emitted but not declared in "
+                        f"METRIC_REGISTRY ({reg_path})",
+                    )
+                )
+        for var, line in _env_key_uses(tree, local_consts, global_consts):
+            if _OBS_ENV_PREFIX.match(var) and var not in env_declared:
+                findings.append(
+                    Finding(
+                        RULE, "undeclared-obs-env", path, line,
+                        f"observability env var '{var}' is read but not "
+                        f"declared in ENV_REGISTRY",
+                    )
+                )
+    return findings

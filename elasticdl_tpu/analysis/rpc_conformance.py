@@ -228,8 +228,11 @@ class _Handler:
         self.cls = cls  # ClassDef or None
 
 
-def _collect_handlers(ctx: AnalysisContext) -> Dict[str, _Handler]:
-    handlers: Dict[str, _Handler] = {}
+def _collect_handlers(ctx: AnalysisContext) -> Dict[str, List[_Handler]]:
+    """Every registration per method name: a method like GetTrace is
+    served by several servicer classes, and per-class rules (fencing)
+    must see each one, not a last-writer-wins pick."""
+    handlers: Dict[str, List[_Handler]] = {}
     for path, tree in ctx.trees():
         module_funcs = {
             n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
@@ -255,8 +258,8 @@ def _collect_handlers(ctx: AnalysisContext) -> Dict[str, _Handler]:
                         and v.value.id == "self"
                     ):
                         func = methods.get(v.attr)
-                    handlers[method] = _Handler(
-                        method, path, k.lineno, func, cls
+                    handlers.setdefault(method, []).append(
+                        _Handler(method, path, k.lineno, func, cls)
                     )
         # RpcServer({...}) with an inline dict literal
         for node in ast.walk(tree):
@@ -273,7 +276,9 @@ def _collect_handlers(ctx: AnalysisContext) -> Dict[str, _Handler]:
                 if method is None or method in handlers:
                     continue
                 func = module_funcs.get(v.id) if isinstance(v, ast.Name) else None
-                handlers[method] = _Handler(method, path, k.lineno, func, None)
+                handlers.setdefault(method, []).append(
+                    _Handler(method, path, k.lineno, func, None)
+                )
     return handlers
 
 
@@ -817,12 +822,14 @@ def run(ctx: AnalysisContext) -> List[Finding]:
                     f"RPC '{s.method}' is called but no handler table "
                     f"registers it",
                 )
-        for method, h in sorted(handlers.items()):
+        for method, hs in sorted(handlers.items()):
             if method not in called:
-                add(
-                    "unused-handler", h.path, h.line,
-                    f"handler for '{method}' is registered but never called",
-                )
+                for h in hs:
+                    add(
+                        "unused-handler", h.path, h.line,
+                        f"handler for '{method}' is registered but never "
+                        f"called",
+                    )
 
     # retry-policy classification
     if idem is not None:
@@ -880,27 +887,30 @@ def run(ctx: AnalysisContext) -> List[Finding]:
                 )
 
     # handler reads vs the schema
-    for method, h in sorted(handlers.items()):
-        if h.func is None or method not in schemas:
+    for method, hs in sorted(handlers.items()):
+        if method not in schemas:
             continue
-        tree_funcs = {}
-        sf = ctx.files.get(h.path)
-        if sf is not None and sf.tree is not None:
-            tree_funcs = {
-                n.name: n
-                for n in sf.tree.body
-                if isinstance(n, ast.FunctionDef)
-            }
-        seen_keys = set()
-        for key, line in _handler_key_reads(h, tree_funcs):
-            if key in schemas[method] or (method, key) in seen_keys:
+        for h in hs:
+            if h.func is None:
                 continue
-            seen_keys.add((method, key))
-            add(
-                "handler-unknown-key", h.path, line,
-                f"handler for '{method}' reads request key '{key}' absent "
-                f"from its wire dataclass",
-            )
+            tree_funcs = {}
+            sf = ctx.files.get(h.path)
+            if sf is not None and sf.tree is not None:
+                tree_funcs = {
+                    n.name: n
+                    for n in sf.tree.body
+                    if isinstance(n, ast.FunctionDef)
+                }
+            seen_keys = set()
+            for key, line in _handler_key_reads(h, tree_funcs):
+                if key in schemas[method] or (method, key) in seen_keys:
+                    continue
+                seen_keys.add((method, key))
+                add(
+                    "handler-unknown-key", h.path, line,
+                    f"handler for '{method}' reads request key '{key}' "
+                    f"absent from its wire dataclass",
+                )
 
     # codec v2 frame-descriptor contract (see module docstring)
     findings.extend(_frame_descriptor_findings(ctx))
@@ -916,10 +926,10 @@ def run(ctx: AnalysisContext) -> List[Finding]:
                 f"WIRE_SCHEMAS declares '{m}' but no handler registers it",
             )
         for m in sorted(set(handlers) - set(schemas)):
-            h = handlers[m]
-            add(
-                "handler-no-schema", h.path, h.line,
-                f"handler for '{m}' has no WIRE_SCHEMAS entry — its "
-                f"request shape is undeclared",
-            )
+            for h in handlers[m]:
+                add(
+                    "handler-no-schema", h.path, h.line,
+                    f"handler for '{m}' has no WIRE_SCHEMAS entry — its "
+                    f"request shape is undeclared",
+                )
     return findings

@@ -100,6 +100,9 @@ ENV_SCHED_SPECULATE = "EDL_SCHED_SPECULATE"
 ENV_SCHED_SPEC_FACTOR = "EDL_SCHED_SPEC_FACTOR"
 ENV_SCHED_SPEC_PCTL = "EDL_SCHED_SPEC_PCTL"
 ENV_SCHED_MAX_BACKUPS = "EDL_SCHED_MAX_BACKUPS"
+ENV_TRACE_SAMPLE = "EDL_TRACE_SAMPLE"
+ENV_METRICS_PORT = "EDL_METRICS_PORT"
+ENV_FLIGHT_RECORDER_EVENTS = "EDL_FLIGHT_RECORDER_EVENTS"
 ENV_K8S_TESTS = "K8S_TESTS"
 ENV_K8S_TEST_IMAGE = "K8S_TEST_IMAGE"
 ENV_K8S_TEST_NAMESPACE = "K8S_TEST_NAMESPACE"
@@ -277,6 +280,21 @@ ENV_REGISTRY = {
     ENV_SCHED_MAX_BACKUPS: (
         "speculation: max concurrent backup copies in flight "
         "(default 2)"
+    ),
+    ENV_TRACE_SAMPLE: (
+        "obs plane: trace sampling probability in [0,1] (default 0 = "
+        "off; 1 traces every request) — per-RPC trace_id/span_id "
+        "envelopes + SpanRecorder spans at every hop (obs/trace.py); "
+        "the off path is a single float compare"
+    ),
+    ENV_METRICS_PORT: (
+        "obs plane: port for the optional Prometheus /metrics HTTP "
+        "listener (obs/metrics.py; unset = no listener — GetMetrics "
+        "RPC and dump APIs still work)"
+    ),
+    ENV_FLIGHT_RECORDER_EVENTS: (
+        "obs plane: flight-recorder ring capacity in events "
+        "(obs/flight.py; default 4096, min 16)"
     ),
     ENV_K8S_TESTS: "1 enables live-cluster tests (tests/test_cluster_gated.py)",
     ENV_K8S_TEST_IMAGE: "worker image for the live-cluster tests",

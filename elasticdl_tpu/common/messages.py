@@ -203,6 +203,20 @@ class GetSchedStatsRequest(_WireRequest):
 
 
 @dataclasses.dataclass
+class GetTraceRequest(_WireRequest):
+    """Drain-free read of a process's SpanRecorder (obs/trace.py):
+    the response carries recorder-shaped span dicts mergeable into one
+    Perfetto timeline via chrome_trace_from_spans."""
+
+
+@dataclasses.dataclass
+class GetMetricsRequest(_WireRequest):
+    """Read of a process's MetricsRegistry snapshot (obs/metrics.py);
+    on the master the response also aggregates process-mode shard
+    fleets."""
+
+
+@dataclasses.dataclass
 class EmbeddingLookupRequest(_WireRequest):
     layer: str = ""
     ids: Any = None
@@ -351,6 +365,8 @@ WIRE_SCHEMAS: Dict[str, type] = {
     "ReportWindowMeta": ReportWindowMetaRequest,
     "ReportPhaseStats": ReportPhaseStatsRequest,
     "GetSchedStats": GetSchedStatsRequest,
+    "GetTrace": GetTraceRequest,
+    "GetMetrics": GetMetricsRequest,
     "EmbeddingLookup": EmbeddingLookupRequest,
     "EmbeddingUpdate": EmbeddingUpdateRequest,
     "PSInit": PSInitRequest,

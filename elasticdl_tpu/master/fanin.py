@@ -75,6 +75,7 @@ from elasticdl_tpu.common.constants import (
     ENV_FANIN_WAIT_MS,
 )
 from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.obs import trace as obs_trace
 
 logger = get_logger(__name__)
 
@@ -167,7 +168,7 @@ def combine_wait_s(env=None) -> float:
 class Member:
     """One push waiting in the combine stage."""
 
-    __slots__ = ("req", "delta", "resp", "error", "event")
+    __slots__ = ("req", "delta", "resp", "error", "event", "tctx")
 
     def __init__(self, req: dict, delta):
         self.req = req
@@ -175,6 +176,9 @@ class Member:
         self.resp = None
         self.error: Optional[BaseException] = None
         self.event = threading.Event()
+        # the submitting handler thread's trace context (the server
+        # span), so the combiner thread's batch span can chain to it
+        self.tctx = obs_trace.current()
 
 
 class CombineBuffer:
@@ -216,7 +220,9 @@ class CombineBuffer:
                 )
                 self._combiner.start()
             self._cond.notify()
-        if not member.event.wait(timeout=_MEMBER_WAIT_S):
+        with obs_trace.span("fanin.park", cat="fanin"):
+            answered = member.event.wait(timeout=_MEMBER_WAIT_S)
+        if not answered:
             raise RuntimeError("combine-buffer combiner stalled")
         if member.error is not None:
             raise member.error
@@ -270,6 +276,16 @@ class CombineBuffer:
             return taken
 
     def _run_batch(self, batch: List[Member]):
+        # the combiner thread has no inherited context; chain the batch
+        # span to the first traced member so the tree stays connected
+        parent = next((m.tctx for m in batch if m.tctx is not None), None)
+        sp = obs_trace.start_span(
+            "fanin.apply_batch",
+            cat="fanin",
+            parent=parent,
+            args={"members": len(batch)},
+        )
+        prev_ctx = obs_trace.bind(sp.ctx) if sp is not None else None
         try:
             self._apply_batch(batch)
             for m in batch:
@@ -280,6 +296,9 @@ class CombineBuffer:
                 if m.resp is None and m.error is None:
                     m.error = e
         finally:
+            if sp is not None:
+                obs_trace.bind(prev_ctx)
+                sp.end()
             # answer only after the whole batch is settled, so no
             # member races ahead of its cohort's bookkeeping
             for m in batch:

@@ -111,6 +111,8 @@ class KVShardGroup:
             shm_generation=self.generations[i],
         )
         servicer.attach_admission_stats(server.admission_stats)
+        servicer.attach_wire_stats(server.wire)
+        servicer.register_metrics()
         server.start()
         return servicer, server
 
@@ -178,6 +180,14 @@ class KVShardGroup:
         mirror, then `wire_mirrors` re-points the ring."""
         i = int(shard_id)
         self.generations[i] += 1
+        from elasticdl_tpu.obs import flight as obs_flight
+
+        obs_flight.record(
+            "generation_bump",
+            shard_kind="kv",
+            shard=i,
+            generation=self.generations[i],
+        )
         if self._mode == "inproc":
             if self._servers:
                 self._servers[i].stop()
@@ -218,6 +228,31 @@ class KVShardGroup:
             i, self.generations[i], self.endpoints[i],
         )
         return self.endpoints[i]
+
+    def collect_shard_metrics(self) -> dict:
+        """Per-shard MetricsRegistry snapshots for the master's
+        GetMetrics fleet aggregation. Inproc shards live in the
+        master's process — their collectors already feed the master's
+        own registry, so only out-of-process shards are polled (one
+        best-effort GetMetrics RPC each; a dead shard contributes
+        nothing rather than failing the scrape)."""
+        if self._mode == "inproc":
+            return {}
+        from elasticdl_tpu.rpc.client import RpcClient
+
+        out = {}
+        for i, endpoint in enumerate(self.endpoints):
+            c = RpcClient(endpoint)
+            try:
+                resp = c.call("GetMetrics", {}, timeout=10.0)
+                out[f"kv{i}"] = resp.get("metrics", {})
+            except Exception as e:  # noqa: BLE001 - scrape is best-effort
+                logger.warning(
+                    "kv shard %d: GetMetrics failed: %s", i, e
+                )
+            finally:
+                c.close()
+        return out
 
     def store(self) -> ShardedEmbeddingStore:
         """The master's store client (SparseOptimizer + checkpoints)."""

@@ -84,8 +84,12 @@ class KVShardServicer:
     # edl-verify (analysis/fencing_conformance.py) can prove every
     # OTHER handler and call site threads an epoch — an undeclared
     # unfenced RPC is a finding, a declared-but-unregistered one too.
+    # GetTrace/GetMetrics answer for the PROCESS (spans/metrics survive
+    # a fence and are exactly what a postmortem wants from a fenced
+    # shard), so they skip the epoch check too.
     UNFENCED_HANDLERS = frozenset(
-        {"KVMirror", "KVMirrorSnapshot", "KVSetMirror"}
+        {"KVMirror", "KVMirrorSnapshot", "KVSetMirror",
+         "GetTrace", "GetMetrics"}
     )
 
     def __init__(self, shard_id: int, num_shards: int, generation: int = 0):
@@ -108,6 +112,13 @@ class KVShardServicer:
         # hosting RpcServer's admission counters (attached by the
         # shard host after server construction)
         self._admission_fn = None
+        # hosting RpcServer's WireStats (attach_wire_stats) — stats
+        # parity with PSShardServicer
+        self._wire = None
+        # request accounting (handlers run lock-free; these are
+        # monotonic best-effort tallies like _mirrored_writes)
+        self._lookups = 0
+        self._updates = 0
 
     def handlers(self) -> Dict[str, Any]:
         return {
@@ -119,7 +130,24 @@ class KVShardServicer:
             "KVMirror": self.kv_mirror,
             "KVMirrorSnapshot": self.kv_mirror_snapshot,
             "KVSetMirror": self.kv_set_mirror,
+            "GetTrace": self.get_trace,
+            "GetMetrics": self.get_metrics,
         }
+
+    def get_trace(self, req: dict) -> dict:
+        """This process's SpanRecorder contents (obs/trace.py)."""
+        from elasticdl_tpu.obs import trace as obs_trace
+
+        return {
+            "spans": obs_trace.RECORDER.snapshot(),
+            "dropped": obs_trace.RECORDER.dropped,
+        }
+
+    def get_metrics(self, req: dict) -> dict:
+        """This process's MetricsRegistry snapshot (obs/metrics.py)."""
+        from elasticdl_tpu.obs import metrics as obs_metrics
+
+        return {"metrics": obs_metrics.get_registry().snapshot()}
 
     def _check_epoch(self, req: dict):
         from elasticdl_tpu.rpc.fencing import check_epoch
@@ -128,11 +156,13 @@ class KVShardServicer:
 
     def kv_lookup(self, req: dict) -> dict:
         self._check_epoch(req)
+        self._lookups += 1
         values, unknown = self._store.lookup(req["layer"], req["ids"])
         return {"values": values, "unknown_index": unknown}
 
     def kv_update(self, req: dict) -> dict:
         self._check_epoch(req)
+        self._updates += 1
         self._store.update(
             req["layer"],
             req["ids"],
@@ -271,16 +301,53 @@ class KVShardServicer:
         (RpcServer.admission_stats)."""
         self._admission_fn = fn
 
+    def attach_wire_stats(self, wire):
+        """Point stats() at the hosting RpcServer's WireStats — same
+        contract as PSShardServicer.attach_wire_stats (stats parity:
+        bytes in/out of a KV shard are as load-bearing for capacity
+        planning as the PS numbers)."""
+        self._wire = wire
+
+    def register_metrics(self, registry=None) -> None:
+        """Feed this shard's counters into the MetricsRegistry as a
+        pull collector (weakly referenced, like
+        PSShardServicer.register_metrics)."""
+        import weakref
+
+        from elasticdl_tpu.obs import metrics as obs_metrics
+
+        reg = registry if registry is not None else obs_metrics.get_registry()
+        ref = weakref.ref(self)
+        shard = str(self.shard_id)
+
+        def collector(sink):
+            s = ref()
+            if s is None:
+                return
+            st = s.stats()
+            sink.gauge("edl_kv_rows", st["n"], shard=shard)
+            sink.gauge("edl_kv_generation", st["generation"], shard=shard)
+            sink.counter("edl_kv_lookups_total", st["lookups"], shard=shard)
+            sink.counter("edl_kv_updates_total", st["updates"], shard=shard)
+
+        reg.register_collector(collector)
+
     def stats(self) -> Dict[str, int]:
         with self._mirror_lock:
             mirror_sources = len(self._mirror_stores)
         out = {
             "n": len(self._store),
             "generation": self.generation,
+            "lookups": self._lookups,
+            "updates": self._updates,
             "mirrored_writes": self._mirrored_writes,
             "mirror_drops": self._mirror_drops,
             "mirror_sources": mirror_sources,
         }
+        if self._wire is not None:
+            snap = self._wire.snapshot()
+            out["bytes_sent"] = snap["bytes_sent"]
+            out["bytes_received"] = snap["bytes_received"]
         if self._admission_fn is not None:
             adm = self._admission_fn()
             if adm:

@@ -89,6 +89,12 @@ def main(argv=None) -> int:
         shm_generation=args.generation,
     )
     servicer.attach_admission_stats(server.admission_stats)
+    servicer.attach_wire_stats(server.wire)
+    servicer.register_metrics()
+
+    from elasticdl_tpu.obs import flight
+
+    flight.install_crash_dump()
     server.start()
     logger.info(
         "KV shard %d/%d (generation %d) listening on :%d",

@@ -540,6 +540,35 @@ def main(argv=None) -> int:
         return out
 
     servicer.set_sched_stats_fn(_sched_stats)
+    # -- observability plane (elasticdl_tpu/obs/) ------------------------
+    # crash flight recorder: an uncaught master exception dumps the
+    # structured event ring (fences, chaos faults, recoveries,
+    # autoscale decisions) as a JSON postmortem artifact
+    from elasticdl_tpu.obs import flight as obs_flight
+    from elasticdl_tpu.obs import metrics as obs_metrics
+
+    obs_flight.install_crash_dump()
+
+    def _phase_collector(sink):
+        # fleet PhaseTimers, cumulative per (phase, worker) — the same
+        # feed GetSchedStats exposes, under declared edl_* names.
+        # Autoscaler/arbiter counters self-report at decision sites.
+        for wid, phases in aggregator.latest_cumulative().items():
+            for name, cell in (phases or {}).items():
+                sink.counter(
+                    "edl_phase_seconds_total",
+                    float(cell.get("seconds", 0.0)),
+                    phase=name,
+                    worker=str(wid),
+                )
+                sink.counter(
+                    "edl_phase_count_total",
+                    float(cell.get("count", 0.0)),
+                    phase=name,
+                    worker=str(wid),
+                )
+
+    obs_metrics.get_registry().register_collector(_phase_collector)
     ps_dead = threading.Event()
     recovery = None
     if servicer.ps_group is not None or servicer.kv_group is not None:

@@ -216,6 +216,7 @@ class PSShardGroup:
         servicer.attach_wire_stats(server.wire)
         servicer.attach_admission_stats(server.admission_stats)
         servicer.attach_shm_publisher(server.shm_broadcaster)
+        servicer.register_metrics()
         server.start()
         return servicer, server
 
@@ -260,6 +261,14 @@ class PSShardGroup:
         re-advertising the endpoint to workers."""
         i = int(shard_id)
         self.generations[i] += 1
+        from elasticdl_tpu.obs import flight as obs_flight
+
+        obs_flight.record(
+            "generation_bump",
+            shard_kind="ps",
+            shard=i,
+            generation=self.generations[i],
+        )
         if self._mode == "inproc":
             if self._servers:
                 self._servers[i].stop()
@@ -323,6 +332,31 @@ class PSShardGroup:
         stop_shard_processes(self._procs)
         self._procs = []
         self.endpoints = []
+
+    def collect_shard_metrics(self) -> dict:
+        """Per-shard MetricsRegistry snapshots for the master's
+        GetMetrics fleet aggregation. Inproc shards live in the
+        master's process — their collectors already feed the master's
+        own registry — so only out-of-process shards are polled (one
+        best-effort GetMetrics RPC each; a dead shard contributes
+        nothing rather than failing the scrape)."""
+        if self._mode == "inproc":
+            return {}
+        from elasticdl_tpu.rpc.client import RpcClient
+
+        out = {}
+        for i, endpoint in enumerate(self.endpoints):
+            c = RpcClient(endpoint)
+            try:
+                resp = c.call("GetMetrics", {}, timeout=10.0)
+                out[f"ps{i}"] = resp.get("metrics", {})
+            except Exception as e:  # noqa: BLE001 - scrape is best-effort
+                logger.warning(
+                    "ps shard %d: GetMetrics failed: %s", i, e
+                )
+            finally:
+                c.close()
+        return out
 
     # -- model plane ---------------------------------------------------------
 

@@ -46,6 +46,7 @@ sees every report exactly once).
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -55,6 +56,7 @@ from elasticdl_tpu.common import codec, messages
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.master import fanin
 from elasticdl_tpu.master.ps_optimizer import PSOptimizer
+from elasticdl_tpu.obs import trace as obs_trace
 
 logger = get_logger(__name__)
 
@@ -173,6 +175,11 @@ class PSShardServicer:
 
     # -- handler table -------------------------------------------------------
 
+    #: Handlers that deliberately skip the fencing epoch check: the obs
+    #: reads answer for the PROCESS (spans/metrics survive a fence and
+    #: are exactly what a postmortem wants from a fenced shard).
+    UNFENCED_HANDLERS = frozenset({"GetTrace", "GetMetrics"})
+
     def handlers(self) -> Dict[str, Any]:
         return {
             "PSInit": self.init_slice,
@@ -181,7 +188,78 @@ class PSShardServicer:
             "PSPushDelta": self.push_delta,
             "PSOptState": self.opt_state,
             "PSOptRestore": self.opt_restore,
+            "GetTrace": self.get_trace,
+            "GetMetrics": self.get_metrics,
         }
+
+    def get_trace(self, req: dict) -> dict:
+        """This process's SpanRecorder contents (obs/trace.py)."""
+        return {
+            "spans": obs_trace.RECORDER.snapshot(),
+            "dropped": obs_trace.RECORDER.dropped,
+        }
+
+    def get_metrics(self, req: dict) -> dict:
+        """This process's MetricsRegistry snapshot (obs/metrics.py)."""
+        from elasticdl_tpu.obs import metrics as obs_metrics
+
+        return {"metrics": obs_metrics.get_registry().snapshot()}
+
+    def register_metrics(self, registry=None) -> None:
+        """Feed this shard's counters into the MetricsRegistry as a
+        pull collector (called by the hosting group/shard-main wiring,
+        like attach_wire_stats). Weakly referenced: a replaced
+        (re-fenced) servicer stops reporting once collected."""
+        from elasticdl_tpu.obs import metrics as obs_metrics
+
+        reg = registry if registry is not None else obs_metrics.get_registry()
+        ref = weakref.ref(self)
+        shard = str(self.shard_id)
+
+        def collector(sink):
+            s = ref()
+            if s is None:
+                return
+            st = s.stats()
+            sink.counter(
+                "edl_ps_applied_pushes_total",
+                st["applied_pushes"],
+                shard=shard,
+            )
+            sink.counter(
+                "edl_ps_duplicate_pushes_total",
+                st["duplicate_pushes"],
+                shard=shard,
+            )
+            sink.gauge("edl_ps_version", st["version"], shard=shard)
+            sink.gauge("edl_ps_generation", st["generation"], shard=shard)
+            sink.counter(
+                "edl_ps_combined_batches_total",
+                st["combined_batches"],
+                shard=shard,
+            )
+            sink.counter(
+                "edl_ps_combined_reports_total",
+                st["combined_reports"],
+                shard=shard,
+            )
+            sink.counter(
+                "edl_prepack_encodes_total",
+                st["prepack_encodes"],
+                shard=shard,
+            )
+            sink.counter(
+                "edl_prepack_served_pulls_total",
+                st["prepack_served_pulls"],
+                shard=shard,
+            )
+            sink.counter(
+                "edl_prepack_copy_bytes_total",
+                st["prepack_encode_copy_bytes"],
+                shard=shard,
+            )
+
+        reg.register_collector(collector)
 
     def _check_epoch(self, req: dict):
         from elasticdl_tpu.rpc.fencing import check_epoch
@@ -325,16 +403,25 @@ class PSShardServicer:
         broadcast segment and the Prepacked carries its descriptor; the
         frame bytes for non-shm tiers materialize lazily from the
         mapped view."""
-        arr = vec if form == "float32" else vec.astype(codec.dtype_from_str(form))
-        obj = {"version": version, "vec": arr}
-        if self._shm_pub is not None:
-            pub = self._shm_pub.publish(obj)
-            if pub is not None:
-                ref, view = pub
-                return messages.Prepacked(
-                    source=lambda v=view: v, shm_ref=ref
-                )
-        return messages.Prepacked(messages.pack(obj))
+        with obs_trace.span(
+            "ps.prepack_encode",
+            cat="ps",
+            args={"shard": self.shard_id, "form": form},
+        ):
+            arr = (
+                vec
+                if form == "float32"
+                else vec.astype(codec.dtype_from_str(form))
+            )
+            obj = {"version": version, "vec": arr}
+            if self._shm_pub is not None:
+                pub = self._shm_pub.publish(obj)
+                if pub is not None:
+                    ref, view = pub
+                    return messages.Prepacked(
+                        source=lambda v=view: v, shm_ref=ref
+                    )
+            return messages.Prepacked(messages.pack(obj))
 
     def push_grad(self, req: dict) -> dict:
         """Per-step gradient slice. Async mode applies immediately
@@ -370,8 +457,15 @@ class PSShardServicer:
                 bool(req.get("return_model")),
             )
             return self._grad_combine.submit(key, req, grad)
-        with self._lock:
-            return self._push_grad_locked(req, grad)
+        # the span covers lock WAIT plus apply — on a contended shard
+        # the wait is the interesting part of the sync critical path
+        with obs_trace.span(
+            "ps.apply",
+            cat="ps",
+            args={"shard": self.shard_id, "kind": "grad"},
+        ):
+            with self._lock:
+                return self._push_grad_locked(req, grad)
 
     def _push_grad_locked(self, req: dict, grad: np.ndarray) -> dict:  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """Serial gradient-report semantics (caller holds the lock):
@@ -450,8 +544,13 @@ class PSShardServicer:
         # dense f32 slice here, OUTSIDE the lock — the compression
         # never leaks into the apply math
         delta = codec.delta_to_f32(req["delta"])
-        with self._lock:
-            return self._push_delta_locked(req, delta)
+        with obs_trace.span(
+            "ps.apply",
+            cat="ps",
+            args={"shard": self.shard_id, "kind": "delta"},
+        ):
+            with self._lock:
+                return self._push_delta_locked(req, delta)
 
     def _push_delta_locked(self, req: dict, delta: np.ndarray) -> dict:  # edl-lint: disable=lock-discipline -- caller holds self._lock
         """Serial window-delta semantics (caller holds the lock): the
@@ -505,9 +604,14 @@ class PSShardServicer:
                 # blocked so the accumulator slice stays L2-resident
                 # across the dense adds; sparse (top-k) members
                 # scatter-add only their shipped entries
-                acc = fanin.presum_f32(
-                    [m.delta for m in members], n=lens[0]
-                )
+                with obs_trace.span(
+                    "fanin.presum",
+                    cat="fanin",
+                    args={"members": len(members)},
+                ):
+                    acc = fanin.presum_f32(
+                        [m.delta for m in members], n=lens[0]
+                    )
         shared_version = None
         shared_vec = None
         # a replay can share a batch with its original (client timed
@@ -518,34 +622,42 @@ class PSShardServicer:
             for m in members
             if m.req.get("report_key")
         ]
-        with self._lock:
-            self._combined_batches += 1
-            self._combined_reports += len(members)
-            fast = (
-                acc is not None
-                and self._vec is not None
-                and not self._staleness_window
-                and acc.shape == self._vec.shape
-                and len(keys) == len(set(keys))
-                and not any(k in self._seen_reports for k in keys)
-            )
-            if fast:
-                self._vec += acc
-                self._version += sum(int(m.req["steps"]) for m in members)
-                for m in members:
-                    self._record_applied(m.req)
-                shared_version = self._version
-                shared_vec = self._wire_vec(members[0].req)
-            else:
-                for m in members:
-                    try:
-                        # densify on demand: anomaly batches are rare
-                        # and must match serial semantics exactly
-                        m.resp = self._push_delta_locked(
-                            m.req, codec.delta_to_f32(m.delta)
-                        )
-                    except Exception as e:
-                        m.error = e
+        with obs_trace.span(
+            "ps.apply",
+            cat="ps",
+            args={"shard": self.shard_id, "kind": "delta_batch"},
+        ):
+            with self._lock:
+                self._combined_batches += 1
+                self._combined_reports += len(members)
+                fast = (
+                    acc is not None
+                    and self._vec is not None
+                    and not self._staleness_window
+                    and acc.shape == self._vec.shape
+                    and len(keys) == len(set(keys))
+                    and not any(k in self._seen_reports for k in keys)
+                )
+                if fast:
+                    self._vec += acc
+                    self._version += sum(
+                        int(m.req["steps"]) for m in members
+                    )
+                    for m in members:
+                        self._record_applied(m.req)
+                    shared_version = self._version
+                    shared_vec = self._wire_vec(members[0].req)
+                else:
+                    for m in members:
+                        try:
+                            # densify on demand: anomaly batches are
+                            # rare and must match serial semantics
+                            # exactly
+                            m.resp = self._push_delta_locked(
+                                m.req, codec.delta_to_f32(m.delta)
+                            )
+                        except Exception as e:
+                            m.error = e
         if fast:
             # one serialization for the whole batch, done off-lock on
             # the leader's thread: every member's base fell behind the
@@ -567,7 +679,12 @@ class PSShardServicer:
         same single acquisition."""
         acc = None
         if len(members) > 1 and len({m.delta.shape for m in members}) == 1:
-            acc = fanin.presum_f32([m.delta for m in members])
+            with obs_trace.span(
+                "fanin.presum",
+                cat="fanin",
+                args={"members": len(members)},
+            ):
+                acc = fanin.presum_f32([m.delta for m in members])
         # same intra-batch uniqueness requirement as the delta applier:
         # a replay sharing a batch with its original must fall back
         keys = [
@@ -575,37 +692,46 @@ class PSShardServicer:
             for m in members
             if m.req.get("report_key")
         ]
-        with self._lock:
-            self._combined_batches += 1
-            self._combined_reports += len(members)
-            fast = (
-                acc is not None
-                and self._vec is not None
-                and not self._use_async
-                and not self._staleness_window
-                and self._grad_n + len(members) < self._grads_to_wait
-                and acc.shape == self._vec.shape
-                and not any(m.req.get("return_model") for m in members)
-                and len(keys) == len(set(keys))
-                and not any(k in self._seen_reports for k in keys)
-            )
-            if fast:
-                if self._grad_sum is None:
-                    self._grad_sum = acc
+        with obs_trace.span(
+            "ps.apply",
+            cat="ps",
+            args={"shard": self.shard_id, "kind": "grad_batch"},
+        ):
+            with self._lock:
+                self._combined_batches += 1
+                self._combined_reports += len(members)
+                fast = (
+                    acc is not None
+                    and self._vec is not None
+                    and not self._use_async
+                    and not self._staleness_window
+                    and self._grad_n + len(members) < self._grads_to_wait
+                    and acc.shape == self._vec.shape
+                    and not any(
+                        m.req.get("return_model") for m in members
+                    )
+                    and len(keys) == len(set(keys))
+                    and not any(k in self._seen_reports for k in keys)
+                )
+                if fast:
+                    if self._grad_sum is None:
+                        self._grad_sum = acc
+                    else:
+                        self._grad_sum += acc
+                    self._grad_n += len(members)
+                    for m in members:
+                        self._record_applied(m.req)
+                    version = self._version
+                    for m in members:
+                        m.resp = {"accepted": True, "version": version}
                 else:
-                    self._grad_sum += acc
-                self._grad_n += len(members)
-                for m in members:
-                    self._record_applied(m.req)
-                version = self._version
-                for m in members:
-                    m.resp = {"accepted": True, "version": version}
-            else:
-                for m in members:
-                    try:
-                        m.resp = self._push_grad_locked(m.req, m.delta)
-                    except Exception as e:
-                        m.error = e
+                    for m in members:
+                        try:
+                            m.resp = self._push_grad_locked(
+                                m.req, m.delta
+                            )
+                        except Exception as e:
+                            m.error = e
 
     # -- internals -----------------------------------------------------------
 

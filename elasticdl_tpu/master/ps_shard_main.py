@@ -130,6 +130,11 @@ def main(argv=None) -> int:
     servicer.attach_wire_stats(server.wire)
     servicer.attach_admission_stats(server.admission_stats)
     servicer.attach_shm_publisher(server.shm_broadcaster)
+    servicer.register_metrics()
+
+    from elasticdl_tpu.obs import flight
+
+    flight.install_crash_dump()
     server.start()
     logger.info(
         "PS shard %d/%d (generation %d) listening on :%d",

@@ -74,6 +74,8 @@ import numpy as np
 
 from elasticdl_tpu.common.constants import ENV_OPT_MIRROR_SECS
 from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.obs import flight as obs_flight
+from elasticdl_tpu.obs import metrics as obs_metrics
 
 logger = get_logger(__name__)
 
@@ -263,6 +265,12 @@ class RecoveryPlane:
             "%s shard %d died (%s): starting recovery", kind.upper(),
             shard_id, why,
         )
+        obs_flight.record(
+            "recovery_begin", shard_kind=kind, shard=shard_id, why=why
+        )
+        obs_metrics.get_registry().inc(
+            "edl_recovery_events_total", event="begin", kind=kind
+        )
         t = threading.Thread(
             target=self._recover,
             args=(kind, shard_id),
@@ -298,6 +306,15 @@ class RecoveryPlane:
             "%s shard %d recovered at generation %d", kind.upper(),
             shard_id, generation,
         )
+        obs_flight.record(
+            "recovery_done",
+            shard_kind=kind,
+            shard=shard_id,
+            generation=generation,
+        )
+        obs_metrics.get_registry().inc(
+            "edl_recovery_events_total", event="done", kind=kind
+        )
 
     def _give_up(self, kind: str, shard_id: int):
         with self._cv:
@@ -307,6 +324,12 @@ class RecoveryPlane:
         logger.error(
             "%s shard %d is UNRECOVERABLE — degrading to fail-fast",
             kind.upper(), shard_id,
+        )
+        obs_flight.record(
+            "recovery_give_up", shard_kind=kind, shard=shard_id
+        )
+        obs_metrics.get_registry().inc(
+            "edl_recovery_events_total", event="give_up", kind=kind
         )
         if self._on_unrecoverable is not None:
             self._on_unrecoverable(kind, shard_id)

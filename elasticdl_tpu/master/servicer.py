@@ -168,6 +168,39 @@ class MasterServicer:
             "PSRestoreFromWorker": self.ps_restore_from_worker,
             "ReportPhaseStats": self.report_phase_stats,
             "GetSchedStats": self.get_sched_stats,
+            "GetTrace": self.get_trace,
+            "GetMetrics": self.get_metrics,
+        }
+
+    # -- observability plane (elasticdl_tpu/obs/) ----------------------------
+
+    def get_trace(self, req: dict) -> dict:
+        """The master process's SpanRecorder contents (obs/trace.py).
+        Merge with per-shard GetTrace snapshots via
+        trace.chrome_trace_from_spans — wall-clock timestamps align
+        processes on one Perfetto timeline."""
+        from elasticdl_tpu.obs import trace as obs_trace
+
+        return {
+            "spans": obs_trace.RECORDER.snapshot(),
+            "dropped": obs_trace.RECORDER.dropped,
+        }
+
+    def get_metrics(self, req: dict) -> dict:
+        """Fleet metrics surface: the master's own MetricsRegistry
+        snapshot (which already includes inproc shard collectors) plus
+        one best-effort GetMetrics poll of every out-of-process PS/KV
+        shard, keyed ps<i>/kv<i>."""
+        from elasticdl_tpu.obs import metrics as obs_metrics
+
+        shards = {}
+        if self._ps_group is not None:
+            shards.update(self._ps_group.collect_shard_metrics())
+        if self._kv_group is not None:
+            shards.update(self._kv_group.collect_shard_metrics())
+        return {
+            "metrics": obs_metrics.get_registry().snapshot(),
+            "shards": shards,
         }
 
     def set_standby_fn(self, fn):
@@ -546,6 +579,7 @@ class MasterServicer:
         report_key = req.get("report_key") or ""
         applied_version = -1
         ckpt_snapshot = None
+        t_apply = time.time()
         with self._lock:
             if self._params is None:
                 raise ValueError("local update reported before model init")
@@ -612,6 +646,17 @@ class MasterServicer:
             if base_version + steps != self._version or req.get("want_model"):
                 resp["params_flat"] = self._flat_model(req.get("model_dtype"))
                 resp["aux"] = jax.tree_util.tree_map(np.copy, self._aux)
+        # lock wait + apply, retro-recorded under the server span (the
+        # duplicate early-return above deliberately skips it)
+        from elasticdl_tpu.obs import trace as obs_trace
+
+        obs_trace.record_event(
+            "master.apply",
+            t_apply,
+            time.time(),
+            cat="ps",
+            args={"kind": "local_update"},
+        )
         # the window's accumulated BET gradients: applied at full
         # weight like the per-step path (the slot state, not an LR
         # damper, governs sparse staleness); outside the lock — see

@@ -1,0 +1,7 @@
+"""Unified observability plane: cross-process sync tracing
+(obs/trace.py), one declared-names metrics surface (obs/metrics.py),
+and a crash flight recorder (obs/flight.py). See docs/observability.md
+for the trace model, span taxonomy, metric naming, and the
+flight-recorder schema."""
+
+from elasticdl_tpu.obs import fetch, flight, metrics, trace  # noqa: F401

@@ -1,0 +1,98 @@
+"""Span-derived sync critical-path breakdown (bench.py's consumer).
+
+Given the recorder-shaped span dicts of a traced run, decompose the
+worker sync chain's wall time into where it went:
+
+- ``encode``      device->host quantize + wire-delta materialization
+                  (``worker.quantize`` + ``worker.encode``)
+- ``queue_wait``  dispatcher admission queue + executor hand-off
+                  (``rpc.admission_wait``; 0 outside loop mode)
+- ``combine``     CombineBuffer park time not covered by the lock
+                  apply (``fanin.park`` minus ``apply``): presum plus
+                  batch-formation overhead. ``fanin.apply_batch`` is
+                  deliberately NOT a component — it wall-overlaps the
+                  members' park and contains the batch ``ps.apply``,
+                  so counting it would double-bill the same seconds.
+- ``apply``       shard-lock / master-lock wait + apply
+                  (``ps.apply`` + ``master.apply``, serial and batch)
+- ``wire``        client-observed RPC time not accounted server-side
+                  (the chain's client spans minus its server spans
+                  minus queue_wait): serialization, transport,
+                  scheduling — the sync push AND the deferred
+                  task-report flush riding the same sync thread
+- ``serve_other`` server handler time that is neither parking nor
+                  applying: decode, version bookkeeping, response
+
+The decomposition is validated against the independently span-measured
+chain wall (the ``worker.window_sync`` roots): ``sum_fraction``
+reports component-sum / sync_wait and bench.py asserts it stays within
+10% of 1 — a drifting fraction means a hop joined the sync chain
+without instrumentation (or one got double-billed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+#: the sync chain's root span
+ROOT = "worker.window_sync"
+
+
+def _dur(spans: Iterable[dict], *names: str) -> float:
+    wanted = set(names)
+    return sum(float(s.get("dur", 0.0)) for s in spans if s["name"] in wanted)
+
+
+def _prefix_dur(spans: Iterable[dict], prefix: str) -> float:
+    return sum(
+        float(s.get("dur", 0.0))
+        for s in spans
+        if s["name"].startswith(prefix)
+    )
+
+
+def sync_critical_path_from_spans(
+    spans: List[Dict[str, Any]], sync_method: str = "ReportLocalUpdate"
+) -> Optional[dict]:
+    """Component breakdown of the sync chain, or None when the span set
+    contains no ``worker.window_sync`` roots (tracing was off)."""
+    roots = [s for s in spans if s["name"] == ROOT]
+    if not roots:
+        return None
+    # chain spans only: the worker's pull/absorb traces are separate
+    # roots and must not leak into the sync-chain accounting. All RPCs
+    # inside the chain count — the deferred task-report flush rides the
+    # sync thread too, and skipping it would undercount "wire".
+    chain_ids = {s["trace_id"] for s in roots}
+    chain = [s for s in spans if s.get("trace_id") in chain_ids]
+    sync_wait = sum(float(s.get("dur", 0.0)) for s in roots)
+    encode = _dur(chain, "worker.quantize", "worker.encode")
+    queue_wait = _dur(chain, "rpc.admission_wait")
+    apply = _dur(chain, "ps.apply", "master.apply")
+    park = _dur(chain, "fanin.park")
+    combine = max(0.0, park - apply)
+    client = _prefix_dur(chain, "rpc.client.")
+    server = _prefix_dur(chain, "rpc.server.")
+    wire = max(0.0, client - server - queue_wait)
+    serve_other = max(0.0, server - park - apply)
+    total = encode + queue_wait + combine + apply + wire + serve_other
+    out = {
+        "rounds": len(roots),
+        "sync_method": sync_method,
+        "sync_wait_s": round(sync_wait, 6),
+        "encode_s": round(encode, 6),
+        "queue_wait_s": round(queue_wait, 6),
+        "combine_s": round(combine, 6) if park > 0.0 else None,
+        "apply_s": round(apply, 6),
+        "wire_s": round(wire, 6),
+        "serve_other_s": round(serve_other, 6),
+        "sum_fraction": (
+            round(total / sync_wait, 4) if sync_wait > 0 else None
+        ),
+    }
+    if out["combine_s"] is None:
+        out["combine_s_skipped_reason"] = (
+            "no fanin.park spans: CombineBuffer fan-in was not active "
+            "on this run (serial shard apply path)"
+        )
+    return out

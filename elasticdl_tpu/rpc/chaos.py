@@ -70,6 +70,8 @@ from elasticdl_tpu.common.constants import (
     ENV_CHAOS_TARGET_ID,
 )
 from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.obs import flight as obs_flight
+from elasticdl_tpu.obs import metrics as obs_metrics
 from elasticdl_tpu.rpc.policy import PolicyRpcError
 
 logger = get_logger(__name__)
@@ -223,6 +225,21 @@ class FaultPlan:
                 if fire:
                     f._fires += 1
                     fired.append(f)
+        # every injection path (both interceptors + both transport
+        # halves) funnels through here, so this is the one place the
+        # flight recorder and metrics see chaos — outside the plan lock
+        for f in fired:
+            obs_flight.record(
+                "chaos_fault",
+                fault=f.kind,
+                method=method,
+                side=side,
+                role=self.role,
+                target=self.target_id,
+            )
+            obs_metrics.get_registry().inc(
+                "edl_chaos_injected_total", kind=f.kind
+            )
         return fired
 
     # -- interceptor factories -----------------------------------------------
@@ -253,6 +270,10 @@ def _method_name(full: str) -> str:
 
 def _crash(method: str, when: str):
     logger.error("chaos: crashing process (%s %s)", when, method)
+    # os._exit skips every excepthook, so the flight recorder must dump
+    # itself here or the postmortem dies with the process
+    obs_flight.record("chaos_crash", method=method, when=when)
+    obs_flight.dump_on_crash(reason="chaos_crash")
     # bypass atexit/finally on purpose: a SIGKILLed pod doesn't clean up
     os._exit(CHAOS_CRASH_EXIT_CODE)
 

@@ -29,6 +29,7 @@ import grpc
 
 from elasticdl_tpu.common import messages
 from elasticdl_tpu.common.constants import GRPC_OPTIONS, SERVICE_NAME
+from elasticdl_tpu.obs import trace as obs_trace
 from elasticdl_tpu.rpc import chaos
 from elasticdl_tpu.rpc.policy import (
     IDEMPOTENT_METHODS,
@@ -93,6 +94,19 @@ class RpcClient:
                 self._calls[method] = stub
         if idempotent is None:
             idempotent = method in IDEMPOTENT_METHODS
+        # trace envelope: the span must exist BEFORE the request is
+        # packed (the envelope rides inside the frame). A call with no
+        # surrounding context starts a new sampled trace — every RPC is
+        # a root candidate. The span covers the whole policy call, so
+        # retries/backoff show inside it.
+        tspan = None
+        if request is None or isinstance(request, dict):
+            tspan = obs_trace.start_span(
+                f"rpc.client.{method}", cat="rpc", root=True
+            )
+            if tspan is not None:
+                request = dict(request or {})
+                request[obs_trace.ENVELOPE_KEY] = tspan.envelope()
         payload = messages.pack(request if request is not None else {})
 
         transport = self._transport
@@ -118,13 +132,19 @@ class RpcClient:
             self.wire.record(method, received=len(resp_bytes))
             return resp_bytes
 
-        resp = self._policy.call(
-            attempt,
-            method=method,
-            timeout=timeout,
-            idempotent=idempotent,
-            breaker=self._breaker,
-        )
+        try:
+            resp = self._policy.call(
+                attempt,
+                method=method,
+                timeout=timeout,
+                idempotent=idempotent,
+                breaker=self._breaker,
+            )
+        finally:
+            if tspan is not None:
+                tspan.end(
+                    transport=transport.name if transport else "grpc"
+                )
         return messages.unpack(resp)
 
     def close(self):

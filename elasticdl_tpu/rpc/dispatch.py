@@ -174,12 +174,20 @@ class AdmissionQueues:
         with self._lock:
             if self._inflight[cls] >= self._depths[cls]:
                 self._rejected[cls] += 1
-                raise PolicyRpcError(
-                    grpc.StatusCode.RESOURCE_EXHAUSTED,
-                    f"{cls} admission queue full "
-                    f"({self._depths[cls]} in flight); retry with backoff",
-                )
-            self._inflight[cls] += 1
+                rejected = True
+            else:
+                self._inflight[cls] += 1
+                rejected = False
+        if rejected:
+            # outside the admission lock: the flight ring has its own
+            from elasticdl_tpu.obs import flight
+
+            flight.record("admission_reject", cls=cls, method=method)
+            raise PolicyRpcError(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"{cls} admission queue full "
+                f"({self._depths[cls]} in flight); retry with backoff",
+            )
         return cls
 
     def leave(self, cls: str) -> None:

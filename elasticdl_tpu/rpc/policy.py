@@ -81,6 +81,9 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
         # snapshot per worker; sched stats is a pure read
         "ReportPhaseStats",
         "GetSchedStats",
+        # obs plane: both are reads of process-local recorders
+        "GetTrace",
+        "GetMetrics",
         # PS shard plane: reads, SETNX init, report_key-deduped pushes,
         # overwrite-semantics opt restore
         "PSInit",

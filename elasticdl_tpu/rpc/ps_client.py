@@ -120,8 +120,24 @@ class ShardedPS:
         product with a safety factor (PSShardGroup.dedup_cap_for) —
         a fixed 512 ring silently broke the guarantee for large fleets
         (ADVICE r5: 64 workers x 8 deep ring around it in one window)."""
+        # pool threads do not inherit the caller's trace context; carry
+        # it across the submit so per-shard client RPC spans chain under
+        # the caller's window/pull span (obs/trace.py)
+        from elasticdl_tpu.obs import trace as obs_trace
+
+        tctx = obs_trace.current()
+
+        def run(c, i):
+            if tctx is None:
+                return fn(c, i)
+            prev = obs_trace.bind(tctx)
+            try:
+                return fn(c, i)
+            finally:
+                obs_trace.bind(prev)
+
         futs = [
-            self._pool.submit(fn, c, i)
+            self._pool.submit(run, c, i)
             for i, c in enumerate(self._clients)
         ]
         return [f.result() for f in futs]

@@ -146,6 +146,62 @@ class RpcServer:
             self._uds.start()
         if self._shm is not None:
             self._shm.start()
+        self._register_metrics()
+
+    def _register_metrics(self):
+        """Feed this server's wire/admission counters into the process
+        MetricsRegistry (pull collectors — zero hot-path cost) and
+        start the optional EDL_METRICS_PORT scrape listener."""
+        from elasticdl_tpu.obs import metrics as obs_metrics
+
+        port = self.port
+        wire = self.wire
+        dispatcher = self._dispatcher
+
+        def collector(sink):
+            snap = wire.snapshot()
+            sink.counter(
+                "edl_wire_bytes_sent_total",
+                snap.get("bytes_sent", 0),
+                side="server",
+                port=port,
+            )
+            sink.counter(
+                "edl_wire_bytes_received_total",
+                snap.get("bytes_received", 0),
+                side="server",
+                port=port,
+            )
+            sink.counter(
+                "edl_wire_calls_total",
+                snap.get("calls", 0),
+                side="server",
+                port=port,
+            )
+            admission = dispatcher.admission_stats()
+            if admission:
+                for cls, row in admission.items():
+                    sink.gauge(
+                        "edl_admission_depth",
+                        row["depth"],
+                        cls=cls,
+                        port=port,
+                    )
+                    sink.gauge(
+                        "edl_admission_inflight",
+                        row["inflight"],
+                        cls=cls,
+                        port=port,
+                    )
+                    sink.counter(
+                        "edl_admission_rejected_total",
+                        row["rejected"],
+                        cls=cls,
+                        port=port,
+                    )
+
+        obs_metrics.get_registry().register_collector(collector)
+        obs_metrics.maybe_serve_from_env()
 
     def wire_stats(self) -> dict:
         """Per-method bytes_sent/bytes_received snapshot (see

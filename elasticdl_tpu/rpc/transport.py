@@ -60,6 +60,7 @@ import socket
 import struct
 import tempfile
 import threading
+import time
 from concurrent import futures
 from multiprocessing import shared_memory as _shm_mod
 from typing import Callable, Dict, Optional
@@ -74,6 +75,7 @@ from elasticdl_tpu.common.constants import (
     ENV_UDS_DIR,
 )
 from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.obs import trace as obs_trace
 from elasticdl_tpu.rpc import dispatch as dispatch_mod
 from elasticdl_tpu.rpc.chaos import (
     transport_faults_after,
@@ -290,10 +292,11 @@ class ServerDispatcher:
                 # direct scheduling: there is no socket to multiplex, so
                 # the caller's thread runs admission + handler inline —
                 # a loop hop would only add two context switches
+                t_admit = time.time()
                 cls = self._admission.enter(method)
                 try:
                     return self._dispatch_blocking(
-                        method, request_bytes, transport
+                        method, request_bytes, transport, t_admit
                     )
                 finally:
                     self._admission.leave(cls)
@@ -321,6 +324,7 @@ class ServerDispatcher:
         half (chaos hooks + legacy sync handler) bridged through the
         bounded executor — handler work and chaos latency sleeps never
         run ON the loop (async-discipline lint)."""
+        t_admit = time.time()
         cls = self._admission.enter(method)
         try:
             return await self._core.loop.run_in_executor(
@@ -329,21 +333,24 @@ class ServerDispatcher:
                 method,
                 request_bytes,
                 transport,
+                t_admit,
             )
         finally:
             self._admission.leave(cls)
 
     def _dispatch_blocking(
-        self, method: str, request_bytes, transport: str
+        self, method: str, request_bytes, transport: str, t_admit=None
     ) -> bytes:
         after = []
         if transport != TRANSPORT_GRPC:
             after = transport_faults_before(self._plan, method, "server")
-        resp_bytes = self._invoke(method, request_bytes, transport)
+        resp_bytes = self._invoke(method, request_bytes, transport, t_admit)
         transport_faults_after(after, method)
         return resp_bytes
 
-    def _invoke(self, method: str, request_bytes, transport: str) -> bytes:
+    def _invoke(
+        self, method: str, request_bytes, transport: str, t_admit=None
+    ) -> bytes:
         from elasticdl_tpu.rpc.fencing import EpochFencedError
 
         fn = self._handlers.get(method)
@@ -357,22 +364,53 @@ class ServerDispatcher:
             method, received=0 if inproc else nbytes, transport=transport
         )
         req = messages.unpack(request_bytes) if request_bytes else None
-        try:
-            resp = fn(req) if req is not None else fn({})
-        except EpochFencedError as e:
-            # fencing rejections are a protocol answer, not a bug:
-            # FAILED_PRECONDITION is non-retryable (policy.RETRYABLE_CODES)
-            # so the client re-resolves instead of re-sending (rpc/fencing.py)
-            logger.warning("RPC %s fenced: %s", method, e)
-            raise PolicyRpcError(
-                grpc.StatusCode.FAILED_PRECONDITION, _sanitized_detail(e)
+        # trace envelope: always popped (handlers never see the key);
+        # a context materializes only when the sender sampled this
+        # request AND this process has tracing on
+        tctx = obs_trace.extract(req)
+        sp = None
+        if tctx is not None:
+            sp = obs_trace.start_span(
+                f"rpc.server.{method}",
+                cat="rpc",
+                parent=tctx,
+                args={"transport": transport},
             )
-        except Exception as e:
-            logger.exception("RPC handler %s failed", method)
-            # carry a sanitized one-line summary so the client can tell
-            # a shape mismatch from an uninitialized shard without
-            # reading server logs
-            raise PolicyRpcError(grpc.StatusCode.INTERNAL, _sanitized_detail(e))
+            if sp is not None and t_admit is not None:
+                # retro-recorded: admission enter + executor queueing
+                # happened before the envelope was parsed
+                obs_trace.record_event(
+                    "rpc.admission_wait",
+                    t_admit,
+                    time.time(),
+                    cat="rpc",
+                    parent=sp.ctx,
+                    args={"method": method},
+                )
+        prev_ctx = obs_trace.bind(sp.ctx) if sp is not None else None
+        try:
+            try:
+                resp = fn(req) if req is not None else fn({})
+            except EpochFencedError as e:
+                # fencing rejections are a protocol answer, not a bug:
+                # FAILED_PRECONDITION is non-retryable (policy.RETRYABLE_CODES)
+                # so the client re-resolves instead of re-sending (rpc/fencing.py)
+                logger.warning("RPC %s fenced: %s", method, e)
+                raise PolicyRpcError(
+                    grpc.StatusCode.FAILED_PRECONDITION, _sanitized_detail(e)
+                )
+            except Exception as e:
+                logger.exception("RPC handler %s failed", method)
+                # carry a sanitized one-line summary so the client can tell
+                # a shape mismatch from an uninitialized shard without
+                # reading server logs
+                raise PolicyRpcError(
+                    grpc.StatusCode.INTERNAL, _sanitized_detail(e)
+                )
+        finally:
+            if sp is not None:
+                obs_trace.bind(prev_ctx)
+                sp.end()
         if (
             transport == TRANSPORT_SHM
             and isinstance(resp, messages.Prepacked)

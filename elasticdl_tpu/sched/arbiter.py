@@ -122,6 +122,18 @@ class PriorityArbiter:
                     handle.name,
                     handle.qos,
                 )
+                from elasticdl_tpu.obs import flight as obs_flight
+                from elasticdl_tpu.obs import metrics as obs_metrics
+
+                obs_flight.record(
+                    "preemption",
+                    victim=victim.name,
+                    beneficiary=handle.name,
+                    workers=reclaimed,
+                )
+                obs_metrics.get_registry().inc(
+                    "edl_sched_preemptions_total", reclaimed
+                )
             granted += reclaimed
         with self._lock:
             self._grants += granted

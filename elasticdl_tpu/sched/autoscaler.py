@@ -102,12 +102,30 @@ class UtilizationAutoscaler:
             with self._lock:
                 self._scale_ups += n
             logger.info("autoscaler: scale up +%d (compute-bound fleet)", n)
+            if n:
+                self._record_decision("autoscale_up", n)
         elif decision == "down":
             n = self._manager.scale_down(self._step)
             with self._lock:
                 self._scale_downs += n
             logger.info("autoscaler: scale down -%d (sync_wait-bound fleet)", n)
+            if n:
+                self._record_decision("autoscale_down", n)
         return decision
+
+    @staticmethod
+    def _record_decision(kind: str, n: int) -> None:
+        """Flight-record the executed decision and advance the matching
+        fleet counter (obs plane)."""
+        from elasticdl_tpu.obs import flight as obs_flight
+        from elasticdl_tpu.obs import metrics as obs_metrics
+
+        obs_flight.record(kind, workers=n)
+        reg = obs_metrics.get_registry()
+        if kind == "autoscale_up":
+            reg.inc("edl_sched_scale_ups_total", n)
+        else:
+            reg.inc("edl_sched_scale_downs_total", n)
 
     # -- background loop ----------------------------------------------------
 

@@ -115,6 +115,17 @@ class PhaseStatsAggregator:
             return None
         return {name: sec / denom for name, sec in totals.items()}
 
+    def latest_cumulative(self) -> Dict[int, dict]:
+        """Newest cumulative PhaseTimers snapshot per worker — the obs
+        metrics collector's feed (counters want cumulative values, not
+        the horizon-windowed deltas `recent_seconds` computes)."""
+        with self._lock:
+            return {
+                wid: hist[-1][1]
+                for wid, hist in self._history.items()
+                if hist
+            }
+
     def snapshot(self) -> dict:
         fr = self.fractions()
         with self._lock:

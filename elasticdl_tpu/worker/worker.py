@@ -52,6 +52,7 @@ from elasticdl_tpu.common.constants import (
 from elasticdl_tpu.common import codec
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.common.timing import PhaseTimers
+from elasticdl_tpu.obs import trace as obs_trace
 from elasticdl_tpu.common.messages import MethodType, Task, TaskType
 from elasticdl_tpu.worker.task_data_service import (
     PrefetchParser,
@@ -399,6 +400,17 @@ class Worker:
 
     def pull_model(self, min_version: int = -1, method: str = MethodType.MINIMUM):
         """reference: worker.py:103-124 (var assign becomes pytree swap)."""
+        with obs_trace.span(
+            "worker.pull",
+            cat="worker",
+            root=True,
+            args={"worker": self._id},
+        ):
+            return self._pull_model_traced(min_version, method)
+
+    def _pull_model_traced(
+        self, min_version: int = -1, method: str = MethodType.MINIMUM
+    ):
         use_flat = (
             self._flat_transport
             and method == MethodType.MINIMUM
@@ -1420,13 +1432,28 @@ class Worker:
             return
         delta_dev = self._flat - self._base_flat  # own buffer, thread-safe
         wire_meta = None
+        # one trace per window: the spawn-side quantize and the async
+        # sync chain (encode / push RPCs / apply) all hang off this
+        # root; it ends when do_sync settles, so its duration IS the
+        # window's sync latency
+        wspan = obs_trace.start_span(
+            "worker.window_sync",
+            cat="worker",
+            root=True,
+            args={"worker": self._id},
+        )
         if self._lossy_sync():
             # EF compression at spawn time, still on the main thread:
             # chained syncs spawn in dispatch order, so each window
             # consumes the residual its predecessor left — the wire
             # carries bf16/int8/top-k but the SUM of what the PS
             # applies tracks the f32 trajectory (see _ef_quantize_delta)
-            wire_meta, delta_dev = self._ef_quantize_delta(delta_dev)
+            with obs_trace.span(
+                "worker.quantize",
+                cat="worker",
+                parent=wspan.ctx if wspan is not None else None,
+            ):
+                wire_meta, delta_dev = self._ef_quantize_delta(delta_dev)
         elif self._transport_dtype == "bfloat16" and _BF16 is not None:
             # plain cast on DEVICE: halves the per-window d2h bytes
             delta_dev = delta_dev.astype(jnp.bfloat16)
@@ -1480,6 +1507,19 @@ class Worker:
             self._spawn_abs[seq] = self._own_steps_abs
 
         def do_sync():
+            # bind the window's root context so every hop below (client
+            # RPC spans, server-side children) chains under it
+            prev_ctx = (
+                obs_trace.bind(wspan.ctx) if wspan is not None else None
+            )
+            try:
+                do_sync_work()
+            finally:
+                if wspan is not None:
+                    obs_trace.bind(prev_ctx)
+                    wspan.end(steps=steps)
+
+        def do_sync_work():
             if prev is not None:
                 prev.join()
             with self._report_lock:
@@ -1493,19 +1533,24 @@ class Worker:
             # grads + the window's task losses — per-item np.asarray
             # would cost a full round-trip each over a high-latency
             # host<->TPU link.
-            delta_h, aux_h, loss_h, step_loss_h, gbets_h = jax.device_get(
-                (
-                    delta_dev,
-                    aux_dev or None,
-                    [l for _, l in losses],
-                    step_loss,
-                    [g for _, g in pending_edl],
+            with obs_trace.span("worker.encode", cat="worker"):
+                delta_h, aux_h, loss_h, step_loss_h, gbets_h = (
+                    jax.device_get(
+                        (
+                            delta_dev,
+                            aux_dev or None,
+                            [l for _, l in losses],
+                            step_loss,
+                            [g for _, g in pending_edl],
+                        )
+                    )
                 )
-            )
-            if wire_meta is not None:
-                # compressed payload: build the codec wire object from
-                # the host copies (device math already ran at spawn)
-                delta_h = self._materialize_wire_delta(wire_meta, delta_h)
+                if wire_meta is not None:
+                    # compressed payload: build the codec wire object
+                    # from the host copies (device math ran at spawn)
+                    delta_h = self._materialize_wire_delta(
+                        wire_meta, delta_h
+                    )
             base_version = spawn_base_version
             req = {
                 "delta_flat": delta_h,
@@ -1871,6 +1916,21 @@ class Worker:
                 pass  # next poll retries
 
     def _absorb_sync_result(self):
+        # lock-free pre-check: absorb runs after every non-blocking
+        # sync poll, and an empty poll should not mint trace spans
+        # (the inner re-check under the lock stays authoritative)
+        # edl-lint: disable=lock-discipline -- racy read is deliberate; _absorb_sync_result_traced re-reads under _report_lock
+        if self._sync_result is None:
+            return
+        with obs_trace.span(
+            "worker.absorb",
+            cat="worker",
+            root=True,
+            args={"worker": self._id},
+        ):
+            self._absorb_sync_result_traced()
+
+    def _absorb_sync_result_traced(self):
         """Apply a piggybacked merged model (another worker advanced
         the PS) — device ops, main thread only. Version bookkeeping
         already happened on the sync thread under the lock.

@@ -1,0 +1,22 @@
+"""metric-registry positive fixture: undeclared emits and obs env reads."""
+
+import os
+
+ENV_REGISTRY = {"EDL_TRACE_SAMPLE": "trace sampling probability"}
+
+METRIC_REGISTRY = {"edl_demo_rows": "rows resident"}
+
+
+def emit(registry):
+    registry.inc("edl_demo_sneaky_total")  # not a METRIC_REGISTRY key
+    registry.set_gauge("edl_demo_rows", 3)  # declared: clean
+
+
+def collect(sink):
+    sink.counter("edl_demo_other_total", 1)  # undeclared via sink too
+
+
+def knobs():
+    # EDL_METRICS_* read missing from ENV_REGISTRY: the obs plane's own
+    # check fires even though env-registry would also flag it
+    return os.getenv("EDL_METRICS_PORT_SNEAKY", "")

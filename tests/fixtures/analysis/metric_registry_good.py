@@ -1,0 +1,26 @@
+"""metric-registry clean twin: every emit declared, obs env declared."""
+
+import os
+
+ENV_TRACE_SAMPLE = "EDL_TRACE_SAMPLE"
+ENV_REGISTRY = {ENV_TRACE_SAMPLE: "trace sampling probability"}
+
+METRIC_NAME = "edl_demo_lookups_total"
+METRIC_REGISTRY = {
+    METRIC_NAME: "lookups served",
+    "edl_demo_rows": "rows resident",
+}
+
+
+def emit(registry):
+    registry.inc(METRIC_NAME)
+    registry.set_gauge("edl_demo_rows", 3, shard="0")
+
+
+def collect(sink):
+    sink.counter(METRIC_NAME, 7)
+    sink.gauge("edl_demo_rows", 3)
+
+
+def sample():
+    return float(os.getenv(ENV_TRACE_SAMPLE, "0"))

@@ -1196,3 +1196,199 @@ def test_fanin_combine_chaos_matches_serial(codec_name):
         np.testing.assert_allclose(
             combined["vec"], serial["vec"], rtol=1e-6, atol=1e-7
         )
+
+
+# -- flight recorder postmortem ordering --------------------------------------
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+def test_flight_recorder_orders_fault_fence_and_recovery():
+    """The flight recorder IS the chaos postmortem: after an injected
+    fault and a shard failover, the master-process ring must hold the
+    whole story — chaos fault -> recovery begin -> generation bump ->
+    recovery done — in causal (seq) order, because every event site
+    funnels through the same lock that assigns seq."""
+    from elasticdl_tpu.master.ps_group import PSShardGroup
+    from elasticdl_tpu.master.recovery import RecoveryPlane
+    from elasticdl_tpu.obs import flight
+
+    from tests.fixtures import linear_module
+
+    class _Stub:
+        def shard_version_floor(self, shard_id):
+            return 1 if int(shard_id) == 1 else -1
+
+    def wait_until(predicate, timeout=15.0, what="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    flight.RECORDER.clear()
+    group = PSShardGroup(
+        2, mode="inproc", use_async=True,
+        optimizer_factory=linear_module.optimizer,
+    )
+    group.start()
+    try:
+        n = 10
+        group.ensure_init(np.arange(n, dtype=np.float32), version=0)
+        client = group.client()
+        versions, vec = client.push_grad(
+            np.full(n, 0.5, np.float32), [0, 0], return_model=True
+        )
+        assert versions == [1, 1]
+
+        # inject a retryable fault through the production interceptor
+        # path — GetTrace is idempotent, so the policy rides over it
+        # and the firing lands in THIS process's flight recorder
+        plan = FaultPlan.from_spec(
+            {
+                "seed": 3,
+                "faults": [
+                    {"kind": "error", "code": "UNAVAILABLE",
+                     "methods": ["GetTrace"], "nth": 1},
+                ],
+            },
+            role="test",
+        )
+        chaotic = RpcClient(
+            group.endpoints[1], policy=fast_policy(), fault_plan=plan
+        )
+        try:
+            assert chaotic.call("GetTrace", {}, timeout=10) is not None
+        finally:
+            chaotic.close()
+
+        plane = RecoveryPlane(
+            _Stub(),
+            ps_group=group,
+            restore_deadline=20.0,
+            opt_mirror_interval=0.05,
+        )
+        plane.start()
+        try:
+            wait_until(
+                lambda: plane.opt_ring_depth(1) >= 1,
+                what="opt mirror ring fill",
+            )
+            plane.on_shard_failure("ps", 1)
+            wait_until(
+                lambda: 1 in plane.status()["ps"], what="shard 1 fenced"
+            )
+            s, e = client.bounds[1]
+            assert plane.offer_upload(7, 1, vec[s:e], 1) is True
+            wait_until(
+                lambda: ("ps", 1, 1) in plane.recoveries(),
+                what="shard 1 recovery",
+            )
+        finally:
+            plane.stop()
+
+        events = flight.RECORDER.snapshot()
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        first = {}
+        for ev in events:
+            first.setdefault(ev["kind"], ev["seq"])
+        story = ["chaos_fault", "recovery_begin", "generation_bump",
+                 "recovery_done"]
+        assert all(k in first for k in story), sorted(first)
+        assert [first[k] for k in story] == sorted(
+            first[k] for k in story
+        ), {k: first[k] for k in story}
+        fault = next(e for e in events if e["kind"] == "chaos_fault")
+        assert fault["fault"] == "error" and fault["method"] == "GetTrace"
+        bump = next(e for e in events if e["kind"] == "generation_bump")
+        assert (bump["shard_kind"], bump["shard"], bump["generation"]) == (
+            "ps", 1, 1,
+        )
+    finally:
+        group.stop()
+        flight.RECORDER.clear()
+
+
+@pytest.mark.e2e
+@pytest.mark.chaos
+def test_traced_chaos_job_over_shm_emits_sync_span_tree(
+    tmp_path, monkeypatch
+):
+    """The chaos job over shm, traced (EDL_TRACE_SAMPLE=1) on the loop
+    dispatch core: the master-process span ring must reconstruct the
+    sync chain worker -> transport -> dispatcher admission -> shard
+    apply as a Perfetto-loadable trace — server spans carry the shm
+    tier and a worker-side parent (the envelope crossed the ring),
+    admission waits chain under them, and the shard applies share their
+    traces. Accounting stays exact: the dispatch core and the tracer
+    change how requests are served and observed, never the result."""
+    from elasticdl_tpu.common.constants import (
+        ENV_DISPATCH,
+        ENV_TRACE_SAMPLE,
+        ENV_TRANSPORT,
+        ENV_UDS_DIR,
+    )
+    from elasticdl_tpu.obs import trace as obs_trace
+    from elasticdl_tpu.testing import write_linear_records
+
+    tmp = str(tmp_path)
+    for i in range(2):
+        write_linear_records(
+            os.path.join(tmp, f"shard-{i}.rio"), 64, seed=i, noise=0.05
+        )
+    monkeypatch.setenv(ENV_TRANSPORT, "shm")
+    monkeypatch.setenv(ENV_UDS_DIR, tmp)
+    monkeypatch.setenv(ENV_DISPATCH, "loop")
+    monkeypatch.setenv(ENV_TRACE_SAMPLE, "1")
+    obs_trace.refresh()
+    obs_trace.RECORDER.clear()
+    chaos_spec = {
+        "seed": 11,
+        "faults": [
+            {"kind": "error", "code": "UNAVAILABLE",
+             "methods": ["PSPushGrad"], "roles": ["worker"], "every": 4,
+             "max_fires": 3},
+            {"kind": "drop", "methods": ["PSPushGrad"], "roles": ["worker"],
+             "nth": 3},
+        ],
+    }
+    try:
+        result = _run_training_job(
+            tmp, "shm-traced-chaos", monkeypatch, chaos_spec
+        )
+        assert result["completed_records"] == 256
+        assert result["versions"] == [16, 16]
+        assert result["applied"] == 32
+        assert result["duplicates"] >= 1, "no drop-retry was deduped"
+
+        spans = obs_trace.RECORDER.snapshot()
+        sync = [s for s in spans if s["name"] == "rpc.server.PSPushGrad"]
+        assert sync, sorted({s["name"] for s in spans})
+        # the envelope crossed the shm ring: every sync serve names the
+        # tier and chains under a worker-process client span
+        assert {s["args"]["transport"] for s in sync} == {"shm"}
+        assert all(s["parent_id"] for s in sync)
+        sync_ids = {s["span_id"] for s in sync}
+        sync_traces = {s["trace_id"] for s in sync}
+        admission = [
+            s for s in spans
+            if s["name"] == "rpc.admission_wait"
+            and s["parent_id"] in sync_ids
+        ]
+        assert admission, "loop-core admission waits missing"
+        applies = [
+            s for s in spans
+            if s["name"] == "ps.apply" and s["trace_id"] in sync_traces
+        ]
+        assert applies, "shard applies did not join the sync traces"
+        assert all(s["parent_id"] for s in applies)
+
+        doc = obs_trace.chrome_trace_from_spans(spans)
+        doc = json.loads(json.dumps(doc))  # serializable end to end
+        assert doc["traceEvents"]
+        assert {e["ph"] for e in doc["traceEvents"]} == {"X"}
+    finally:
+        obs_trace.configure(None)
+        obs_trace.RECORDER.clear()

@@ -737,6 +737,51 @@ def test_env_registry_ignores_unprefixed(tmp_path):
     assert run_analysis(root, rules=["env-registry"]) == []
 
 
+# -- metric-registry ----------------------------------------------------------
+# fixtures are real files so the obs docs can point at runnable examples
+
+METRIC_GOOD = _fixture("metric_registry_good.py")
+METRIC_BAD = _fixture("metric_registry_bad.py")
+
+
+def test_metric_registry_clean(tmp_path):
+    root = _tree(tmp_path, {"mod.py": METRIC_GOOD})
+    assert run_analysis(root, rules=["metric-registry"]) == []
+
+
+def test_metric_registry_flags_undeclared_and_obs_env(tmp_path):
+    root = _tree(tmp_path, {"mod.py": METRIC_BAD})
+    findings = run_analysis(root, rules=["metric-registry"])
+    assert _checks(findings, "metric-registry") == {
+        "undeclared-metric",
+        "undeclared-obs-env",
+    }
+    assert any("edl_demo_sneaky_total" in f.message for f in findings)
+    assert any("edl_demo_other_total" in f.message for f in findings)
+    assert any("EDL_METRICS_PORT_SNEAKY" in f.message for f in findings)
+
+
+def test_metric_registry_no_registry(tmp_path):
+    src = 'def emit(reg):\n    reg.inc("edl_orphan_total")\n'
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["metric-registry"]), "metric-registry"
+    )
+    assert checks == {"no-metric-registry"}
+
+
+def test_metric_registry_ignores_non_edl_and_computed(tmp_path):
+    src = (
+        'METRIC_REGISTRY = {"edl_x": "x"}\n'
+        "\n\n"
+        "def emit(reg, name):\n"
+        '    reg.inc("requests_total")\n'  # unprefixed: someone else's
+        "    reg.inc(name)\n"  # computed: not statically resolvable
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    assert run_analysis(root, rules=["metric-registry"]) == []
+
+
 # -- edl-verify: fencing-conformance ------------------------------------------
 # the interprocedural families keep their fixtures as real files under
 # tests/fixtures/analysis/ (positive + clean twin per rule)
@@ -1083,6 +1128,7 @@ def test_cli_rule_selection(tmp_path, rule):
         "lock-discipline": LOCK_BAD,
         "jit-purity": JIT_BAD,
         "env-registry": ENV_BAD,
+        "metric-registry": METRIC_BAD,
         "fencing-conformance": FENCING_BAD,
         "lock-order": LOCK_ORDER_BAD,
         "abort-discipline": ABORT_BAD,
@@ -1213,7 +1259,8 @@ def test_repo_unfenced_declaration_matches_runtime():
     assert declared == set(KVShardServicer.UNFENCED_HANDLERS)
     registered = {
         h.method
-        for h in rc._collect_handlers(ctx).values()
+        for hs in rc._collect_handlers(ctx).values()
+        for h in hs
         if h.cls is not None and h.cls.name == "KVShardServicer"
     }
     assert declared < registered  # declared, registered, and not all
@@ -1225,7 +1272,7 @@ def test_repo_handler_reachability_covers_helpers():
     ctx = load_context(PKG_ROOT)
     g = cg.CallGraph(ctx)
     roots = []
-    for h in rc._collect_handlers(ctx).values():
+    for h in (h for hs in rc._collect_handlers(ctx).values() for h in hs):
         if h.func is None:
             continue
         key = (h.path, h.cls.name if h.cls else None, h.func.name)
