@@ -67,6 +67,7 @@ def run_job(
     staleness_window=0,
     step_pipeline=0,
     spec_overrides=None,
+    overlap_sync=None,
 ):
     """One full PS training job; returns (images_per_sec, worker, wall).
 
@@ -133,6 +134,7 @@ def run_job(
         step_pipeline=step_pipeline,
         sync_dtype=sync_dtype,
         sync_compress=sync_compress,
+        overlap_sync=overlap_sync,
     )
 
     # ---- untimed AOT warm-up: compile + one throwaway execution ----
@@ -158,6 +160,9 @@ def run_job(
     elapsed = time.time() - t0
     wire = client.wire.snapshot()
     worker.close()
+    # final PS version BEFORE teardown: the overlap A/B asserts
+    # exactness (version == applied pushes) per cell against it
+    _fp, _fa, worker.final_version = servicer.get_params_copy()
     server.stop()
     assert ok and dispatcher.finished() and not dispatcher.has_failed_tasks()
     # bytes-per-sync for the mode's sync RPC (request = delta/grad up,
@@ -773,32 +778,152 @@ def main():
         file=sys.stderr,
     )
 
+    # ---- overlap plane A/B: exposed sync fraction + per-link ratio ----
+    # Same traced protocol as the critical path, run once per gate
+    # state. overlap_sync=off serializes the chain (every window's full
+    # sync wall lands on the step loop); =on leaves only residual
+    # stalls (final drain, beyond-depth backpressure). The acceptance
+    # metric is the span-measured sync_exposed_wall / total_wall
+    # fraction, which must drop >= 2x, with exactness (final PS version
+    # == applied pushes x window) asserted in every cell. 16 exact-fit
+    # windows (4096 records / mb 128 / W=2) so the off cell has enough
+    # stalls to measure and the on cell's drain amortizes.
+    from elasticdl_tpu.obs.critical_path import (
+        sync_exposed_fraction_from_spans,
+    )
+
+    overlap_ab = {}
+    ab_w = 2
+    for mode in ("off", "on"):
+        prev_sample = os.environ.get(ENV_TRACE_SAMPLE)
+        os.environ[ENV_TRACE_SAMPLE] = "1"
+        obs_trace.refresh()
+        obs_trace.RECORDER.clear()
+        ab_link_before = _probe_link_mbps()
+        try:
+            ab_imgs, ab_worker, ab_wall = run_job(
+                model_module,
+                path,
+                4096,
+                minibatch=minibatch,
+                records_per_task=512,
+                epochs=1,
+                local_updates=ab_w,
+                grads_to_wait=1,
+                sync_dtype="bfloat16",
+                overlap_sync=mode,
+            )
+        finally:
+            if prev_sample is None:
+                os.environ.pop(ENV_TRACE_SAMPLE, None)
+            else:
+                os.environ[ENV_TRACE_SAMPLE] = prev_sample
+            obs_trace.refresh()
+        ab_link = round(max(ab_link_before, _probe_link_mbps()), 1)
+        exposed = sync_exposed_fraction_from_spans(
+            obs_trace.RECORDER.snapshot(), ab_wall
+        )
+        assert exposed is not None, (
+            "overlap A/B traced run recorded no worker.sync_exposed / "
+            "worker.window_sync spans — the stall instrumentation is "
+            "gone (worker/worker.py _sync_exposed)"
+        )
+        ws = ab_worker.wire_summary
+        # exactness in every cell: the PS applied exactly the pushed
+        # windows (version advances by `steps` per applied window)
+        assert (
+            ab_worker.final_version == ws["sync_calls"] * ab_w
+            and ws["sync_calls"] > 0
+        ), (
+            f"overlap_sync={mode}: final version "
+            f"{ab_worker.final_version} != {ws['sync_calls']} applied "
+            f"pushes x {ab_w} steps — the overlap path dropped or "
+            "double-applied a window"
+        )
+        overlap_ab[mode] = {
+            "images_per_sec": round(ab_imgs, 1),
+            "link_mbps": ab_link,
+            "imgs_per_sec_per_link_mbps": round(ab_imgs / ab_link, 3)
+            if ab_link
+            else None,
+            "final_version": ab_worker.final_version,
+            "applied_pushes": ws["sync_calls"],
+            **exposed,
+        }
+    _frac_off = overlap_ab["off"]["sync_exposed_fraction"]
+    _frac_on = overlap_ab["on"]["sync_exposed_fraction"]
+    overlap_ab["exposed_fraction_drop"] = (
+        round(_frac_off / max(_frac_on, 1e-9), 2)
+    )
+    _plm_on = overlap_ab["on"]["imgs_per_sec_per_link_mbps"]
+    _plm_off = overlap_ab["off"]["imgs_per_sec_per_link_mbps"]
+    overlap_ab["per_link_ratio_on_vs_off"] = (
+        round(_plm_on / _plm_off, 3) if _plm_on and _plm_off else None
+    )
+    assert overlap_ab["exposed_fraction_drop"] >= 2.0, (
+        f"overlap plane failed its acceptance gate: exposed sync "
+        f"fraction only dropped {overlap_ab['exposed_fraction_drop']}x "
+        f"(off {_frac_off} -> on {_frac_on}); stalls by reason: "
+        f"off={overlap_ab['off']['by_reason']} "
+        f"on={overlap_ab['on']['by_reason']}"
+    )
+    print(
+        f"bench[overlap A/B]: exposed sync fraction "
+        f"off {_frac_off} -> on {_frac_on} "
+        f"({overlap_ab['exposed_fraction_drop']}x drop), "
+        f"img/s per link-MB/s ratio on/off "
+        f"{overlap_ab['per_link_ratio_on_vs_off']}",
+        file=sys.stderr,
+    )
+
     # ---- north-star model: ResNet-50 chip throughput ----
     # (bench_resnet.py holds the full story incl. the elastic-runtime
     # number and the link physics; the chip number rides the driver's
-    # JSON record here)
+    # JSON record here.) Re-measured EVERY round on EVERY backend:
+    # BENCH_r05 recorded resnet50_chip null because the cell hid
+    # behind an `if on_tpu:` gate — off-TPU the probe now runs a
+    # scaled-down shape, labeled with its backend, and a failed probe
+    # states the exception instead of silently recording null.
     resnet = None
-    if on_tpu:
+    resnet_skip = None
+    try:
         from bench_resnet import chip_throughput
 
-        # b256: +40% img/s over the b64 number earlier rounds carried
-        # (batch is the biggest MFU lever; sweep + trace breakdown in
-        # docs/resnet_mfu.md) and weather-stable (longer scans amortize
-        # launch latency)
+        if on_tpu:
+            # b256: +40% img/s over the b64 number earlier rounds
+            # carried (batch is the biggest MFU lever; sweep + trace
+            # breakdown in docs/resnet_mfu.md) and weather-stable
+            # (longer scans amortize launch latency)
+            r_res, r_batch, r_steps, r_reps = 224, 256, 8, 3
+        else:
+            # CPU reference probe: tiny shape so the MFU reference is
+            # still re-measured (vs the v5e bf16 peak, so the CPU
+            # number is honest about being ~0)
+            r_res, r_batch, r_steps, r_reps = 64, 16, 2, 1
         r_ips, r_tf, r_mfu, _rl = chip_throughput(
-            res=224, batch=256, steps=8, reps=3
+            res=r_res, batch=r_batch, steps=r_steps, reps=r_reps
         )
         resnet = {
-            "images_per_sec_chip_224": round(r_ips, 1),
-            "batch": 256,
+            "images_per_sec_chip": round(r_ips, 1),
+            "res": r_res,
+            "batch": r_batch,
+            "backend": backend,
             "tflops_per_sec": round(r_tf, 2),
             "mfu_vs_v5e_bf16_peak": round(r_mfu, 4),
         }
         print(
-            f"bench[resnet50 chip]: {r_ips:.1f} img/s @224 = "
-            f"{r_tf:.1f} TFLOP/s = {100 * r_mfu:.1f}% MFU",
+            f"bench[resnet50 chip]: {r_ips:.1f} img/s @{r_res} "
+            f"({backend}) = {r_tf:.1f} TFLOP/s = "
+            f"{100 * r_mfu:.1f}% MFU",
             file=sys.stderr,
         )
+    except Exception as e:
+        resnet_skip = (
+            f"chip_throughput failed on backend {backend!r}: "
+            f"{type(e).__name__}: {e}"
+        )
+        print(f"bench[resnet50 chip]: SKIPPED — {resnet_skip}",
+              file=sys.stderr)
 
     record = {
         "metric": "cifar10_ps_training_images_per_sec",
@@ -855,6 +980,11 @@ def main():
         # combine / apply / wire — gated on the components re-composing
         # the span-measured sync wall within 10% (sum_fraction)
         "sync_critical_path": critical_path,
+        # overlap plane A/B (--overlap_sync on vs off, traced): the
+        # span-measured fraction of step-loop wall spent blocked on
+        # the sync plane, per cell, with exactness asserted; the gate
+        # (exposed_fraction_drop >= 2) already passed above
+        "overlap_ab": overlap_ab,
         "resnet50_chip": resnet,
         "window_runs_images_per_sec": [
             round(a[0], 1) for a in attempts
@@ -936,6 +1066,18 @@ def main():
             "clients x 16 pulls of one 4 MB model version, "
             "served from one cached encode (over shm via a "
             "mapped broadcast segment, 0 payload copies). "
+            "overlap_ab is the overlap-plane A/B (16 exact-fit "
+            "windows, traced): sync_exposed_fraction is the "
+            "span-measured share of step-loop wall spent "
+            "blocked on the sync plane (worker.sync_exposed "
+            "stall spans / job wall), asserted to drop >= 2x "
+            "with overlap_sync=on, with per-cell exactness "
+            "(final PS version == applied pushes x window "
+            "steps); imgs_per_sec_per_link_mbps normalizes "
+            "each cell by its bracketing link probes. "
+            "resnet50_chip is re-measured every round on every "
+            "backend (off-TPU: a scaled-down shape labeled "
+            "with its backend). "
             "Fields reported null carry a sibling "
             "<field>_skipped_reason stating why the number is "
             "absent from this run"
@@ -947,8 +1089,8 @@ def main():
     # gains a <field>_skipped_reason sibling
     skip_reasons = {
         "resnet50_chip": (
-            f"backend is {backend!r}; the ResNet-50 chip-throughput "
-            "bench runs only on tpu"
+            resnet_skip
+            or "chip_throughput returned nothing despite not raising"
         ),
         "model_tflops_per_sec": (
             "worker reported no window FLOP count (XLA cost analysis "

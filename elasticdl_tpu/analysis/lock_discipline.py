@@ -26,6 +26,18 @@ to carry a def-line suppression naming the contract, e.g.::
 
     def _apply(self, grad):  # edl-lint: disable=lock-discipline -- caller holds self._lock
 
+Beyond write-site inference, a class may DECLARE its guarded set::
+
+    SYNC_GUARDED_ATTRS = {"_lock": ("_staged", "_result")}
+
+Declared attrs are guarded by the named lock regardless of whether any
+write happens under it — the contract survives refactors that move or
+remove the guarded writes, so a bare cross-thread read (e.g. a step
+loop peeking at sync-thread staging state) stays a finding forever.
+Naming a lock the class never constructs is itself a finding
+(``bad-guard-declaration``): a typo must not silently disable the
+declared contract.
+
 Findings are aggregated to one per (class, method, attribute) so a
 method touching one attribute five times reads as one defect.
 """
@@ -47,6 +59,35 @@ _MUTATORS = {
 
 #: attribute calls that block the calling thread
 _BLOCKING_ATTRS = {"call", "result", "join", "wait", "wait_ready"}
+
+#: class-level declaration of lock -> guarded attrs (see module doc)
+_DECL_NAME = "SYNC_GUARDED_ATTRS"
+
+
+def _declared_guarded(cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    """Parse ``SYNC_GUARDED_ATTRS = {"_lock": ("_attr", ...)}`` from the
+    class body into attr -> {locks} (the same shape as the inferred
+    ``guarded`` map). Non-literal shapes are ignored — the declaration
+    is a static contract, mirroring async_discipline's
+    ``LOOP_ONLY_ATTRS`` parsing."""
+    out: Dict[str, Set[str]] = {}
+    for node in cls.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Name) and t.id == _DECL_NAME):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                continue
+            if not isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                continue
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.setdefault(el.value, set()).add(k.value)
+    return out
 
 
 def _is_lock_ctor(node: ast.expr) -> bool:
@@ -242,7 +283,8 @@ def _scan_class(path: str, cls: ast.ClassDef) -> List[Finding]:
                     attr = _self_attr(t)
                     if attr is not None:
                         lock_attrs.add(attr)
-    if not lock_attrs:
+    declared = _declared_guarded(cls)
+    if not lock_attrs and not declared:
         return []
     # second pass: Condition wrappers. Condition(self._lock) aliases to
     # the wrapped lock; a bare Condition() guards as its own lock.
@@ -280,6 +322,26 @@ def _scan_class(path: str, cls: ast.ClassDef) -> List[Finding]:
                 guarded.setdefault(acc.attr, set()).update(acc.held)
 
     findings: List[Finding] = []
+    # declared contract: seed/extend the inferred map, and flag
+    # declarations naming a lock the class never constructs (a typo'd
+    # lock name must not silently void the contract)
+    for attr, locks in declared.items():
+        known = {
+            lk for lk in locks if lk in lock_attrs or lk in aliases
+        }
+        for lk in sorted(locks - known):
+            findings.append(
+                Finding(
+                    RULE, "bad-guard-declaration", path, cls.lineno,
+                    f"{cls.name}.{_DECL_NAME} declares self.{attr} "
+                    f"guarded by self.{lk}, but the class never "
+                    f"creates that lock",
+                )
+            )
+        if known:
+            guarded.setdefault(attr, set()).update(
+                aliases.get(lk, lk) for lk in known
+            )
     for m in methods:
         if m.name == "__init__":
             continue

@@ -89,6 +89,14 @@ def add_model_spec_args(parser: argparse.ArgumentParser):
         "with --sync_dtype int8/bf16 for the values (default off). "
         "EDL_SYNC_COMPRESS overrides.",
     )
+    parser.add_argument(
+        "--overlap_sync", default="", choices=("", "on", "off"),
+        help="worker overlap plane: on (default) pipelines window-delta "
+        "encode/push on sync threads, pages model-down in on a "
+        "background thread, and enables BET prefetch; off restores the "
+        "serial blocking sync chain bit-for-bit (A/B + exactness "
+        "audits). EDL_OVERLAP_SYNC overrides.",
+    )
     parser.add_argument("--log_level", default="INFO")
     parser.add_argument(
         "--profile_dir", default="",
@@ -523,6 +531,8 @@ def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:
         argv += ["--sync_dtype", args.sync_dtype]
     if getattr(args, "sync_compress", ""):
         argv += ["--sync_compress", args.sync_compress]
+    if getattr(args, "overlap_sync", ""):
+        argv += ["--overlap_sync", args.overlap_sync]
     for flag in (
         "model_params",
         "dataset_fn",

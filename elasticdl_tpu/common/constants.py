@@ -67,6 +67,7 @@ ENV_RPC_RETRIES = "EDL_RPC_RETRIES"
 ENV_RPC_BACKOFF = "EDL_RPC_BACKOFF"
 ENV_RPC_SEED = "EDL_RPC_SEED"
 ENV_SYNC_DEPTH = "EDL_SYNC_DEPTH"
+ENV_OVERLAP_SYNC = "EDL_OVERLAP_SYNC"
 ENV_SYNC_DTYPE = "EDL_SYNC_DTYPE"
 ENV_SYNC_COMPRESS = "EDL_SYNC_COMPRESS"
 ENV_TRANSPORT = "EDL_TRANSPORT"
@@ -103,6 +104,7 @@ ENV_SCHED_MAX_BACKUPS = "EDL_SCHED_MAX_BACKUPS"
 ENV_TRACE_SAMPLE = "EDL_TRACE_SAMPLE"
 ENV_METRICS_PORT = "EDL_METRICS_PORT"
 ENV_FLIGHT_RECORDER_EVENTS = "EDL_FLIGHT_RECORDER_EVENTS"
+ENV_FLIGHT_DIR = "EDL_FLIGHT_DIR"
 ENV_K8S_TESTS = "K8S_TESTS"
 ENV_K8S_TEST_IMAGE = "K8S_TEST_IMAGE"
 ENV_K8S_TEST_NAMESPACE = "K8S_TEST_NAMESPACE"
@@ -126,6 +128,13 @@ ENV_REGISTRY = {
     ENV_SYNC_DEPTH: (
         "max in-flight pipelined window syncs per worker (0 serializes; "
         "default 2)"
+    ),
+    ENV_OVERLAP_SYNC: (
+        "worker overlap plane: on (default) pipelines window-delta "
+        "encode/push on sync threads, absorbs model-down in the "
+        "background at step boundaries, and enables BET prefetch; off "
+        "restores the serial blocking sync chain bit-for-bit "
+        "(worker/worker.py; CLI --overlap_sync)"
     ),
     ENV_SYNC_DTYPE: (
         "sync-plane wire dtype: bf16 or int8 sends window deltas / "
@@ -295,6 +304,11 @@ ENV_REGISTRY = {
     ENV_FLIGHT_RECORDER_EVENTS: (
         "obs plane: flight-recorder ring capacity in events "
         "(obs/flight.py; default 4096, min 16)"
+    ),
+    ENV_FLIGHT_DIR: (
+        "obs plane: directory for flight-recorder crash dumps "
+        "(edl_flight_<pid>.json); default <tmpdir>/edl-flight — never "
+        "the working directory (obs/flight.py)"
     ),
     ENV_K8S_TESTS: "1 enables live-cluster tests (tests/test_cluster_gated.py)",
     ENV_K8S_TEST_IMAGE: "worker image for the live-cluster tests",

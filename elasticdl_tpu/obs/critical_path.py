@@ -37,6 +37,11 @@ from typing import Any, Dict, Iterable, List, Optional
 #: the sync chain's root span
 ROOT = "worker.window_sync"
 
+#: step-loop stall spans (worker._sync_exposed): wall time the main
+#: thread spent BLOCKED on the sync plane, tagged with a reason
+#: (join / pull / bg_pull / backpressure / flush / drain)
+EXPOSED = "worker.sync_exposed"
+
 
 def _dur(spans: Iterable[dict], *names: str) -> float:
     wanted = set(names)
@@ -96,3 +101,39 @@ def sync_critical_path_from_spans(
             "on this run (serial shard apply path)"
         )
     return out
+
+
+def sync_exposed_fraction_from_spans(
+    spans: List[Dict[str, Any]], total_wall_s: float
+) -> Optional[dict]:
+    """EXPOSED sync accounting: of `total_wall_s` of step-loop wall,
+    how much was spent blocked on the sync plane (the
+    ``worker.sync_exposed`` stall spans)? This is the overlap plane's
+    headline metric — ``sync_critical_path_from_spans`` decomposes
+    where sync time GOES, this measures how much of it stayed ON the
+    step loop's critical path. overlap_sync=off exposes every window's
+    full sync wall; =on should leave only residual stalls (final
+    drain, beyond-depth backpressure), so bench.py's A/B asserts the
+    fraction drops.
+
+    Returns None when the span set has no stall spans at all AND no
+    sync roots (tracing was off — indistinguishable from a stall-free
+    run only when the run also produced no windows)."""
+    stalls = [s for s in spans if s.get("name") == EXPOSED]
+    if not stalls and not any(s.get("name") == ROOT for s in spans):
+        return None
+    exposed = sum(float(s.get("dur", 0.0)) for s in stalls)
+    by_reason: Dict[str, float] = {}
+    for s in stalls:
+        reason = str((s.get("args") or {}).get("reason", "unknown"))
+        by_reason[reason] = by_reason.get(reason, 0.0) + float(
+            s.get("dur", 0.0)
+        )
+    total = max(float(total_wall_s), 1e-9)
+    return {
+        "stalls": len(stalls),
+        "sync_exposed_wall_s": round(exposed, 6),
+        "total_wall_s": round(float(total_wall_s), 6),
+        "sync_exposed_fraction": round(exposed / total, 6),
+        "by_reason": {k: round(v, 6) for k, v in sorted(by_reason.items())},
+    }

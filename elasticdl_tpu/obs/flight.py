@@ -24,12 +24,16 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from elasticdl_tpu.common.constants import ENV_FLIGHT_RECORDER_EVENTS
+from elasticdl_tpu.common.constants import (
+    ENV_FLIGHT_DIR,
+    ENV_FLIGHT_RECORDER_EVENTS,
+)
 
 _DEFAULT_EVENTS = 4096
 
@@ -117,9 +121,23 @@ _crash_installed = False
 _crash_lock = threading.Lock()
 
 
+def crash_dump_dir() -> str:
+    """Directory for crash dumps: EDL_FLIGHT_DIR, else a tmp subdir —
+    never the working directory (stray dumps used to litter repo
+    checkouts)."""
+    d = os.environ.get(ENV_FLIGHT_DIR, "").strip() or os.path.join(
+        tempfile.gettempdir(), "edl-flight"
+    )
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        d = tempfile.gettempdir()
+    return d
+
+
 def crash_dump_path() -> str:
     return _crash_path or os.path.join(
-        os.getcwd(), f"edl_flight_{os.getpid()}.json"
+        crash_dump_dir(), f"edl_flight_{os.getpid()}.json"
     )
 
 

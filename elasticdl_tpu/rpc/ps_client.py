@@ -45,6 +45,11 @@ class ShardedPS:
         self._pool = ThreadPoolExecutor(
             max_workers=len(endpoints), thread_name_prefix="ps-shard"
         )
+        # pull_async runner — deliberately NOT self._pool: pull() itself
+        # fans out into that pool, so running pull() ON it would
+        # deadlock at num_shards in-flight pulls (classic nested-submit
+        # starvation). Lazy: most callers never go async.
+        self._async_pool = None
 
     @property
     def num_shards(self) -> int:
@@ -206,6 +211,26 @@ class ShardedPS:
                 new_versions[i] = resps[i]["version"]
         return new_versions, self._assemble([r["vec"] for r in resps])
 
+    def pull_async(
+        self,
+        versions: Optional[List[int]] = None,
+        model_dtype: Optional[str] = None,
+    ):
+        """Non-blocking `pull`: returns a Future resolving to the same
+        (shard_versions, vec|None). The worker's overlap plane uses
+        this to page a newer model in while the step loop computes —
+        the transport stack is safe for it (RpcClient serializes per
+        endpoint under `_calls_lock`; the shm tier checks out pooled
+        connections per call), so an async pull may overlap concurrent
+        push_delta fan-outs on the same client."""
+        if self._async_pool is None:
+            self._async_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ps-pull-async"
+            )
+        return self._async_pool.submit(
+            self.pull, versions=versions, model_dtype=model_dtype
+        )
+
     def push_delta(
         self,
         delta: np.ndarray,
@@ -359,5 +384,7 @@ class ShardedPS:
 
     def close(self):
         self._pool.shutdown(wait=False)
+        if self._async_pool is not None:
+            self._async_pool.shutdown(wait=False)
         for c in self._clients:
             c.close()

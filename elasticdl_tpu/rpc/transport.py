@@ -1436,14 +1436,21 @@ class ShmTransport:
         with self._bcast_lock:
             ent = self._bcast.get(name)
         if ent is None:
-            try:
-                seg = _attach_shm_segment(name)
-            except (OSError, ValueError) as e:
-                raise PolicyRpcError(
-                    grpc.StatusCode.UNAVAILABLE,
-                    f"shm broadcast segment {name} rotated: {e}",
-                )
-            view = memoryview(seg.buf)
+            # first touch of this segment in this process: the actual
+            # page-in cost of the zero-copy model-down path — spanned
+            # so the overlap A/B's traces show where it lands (on the
+            # background absorb thread, not the step loop)
+            with obs_trace.span(
+                "rpc.client.bcast_map", cat="rpc", args={"seg": name}
+            ):
+                try:
+                    seg = _attach_shm_segment(name)
+                except (OSError, ValueError) as e:
+                    raise PolicyRpcError(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"shm broadcast segment {name} rotated: {e}",
+                    )
+                view = memoryview(seg.buf)
             evicted = []
             with self._bcast_lock:
                 if name not in self._bcast:

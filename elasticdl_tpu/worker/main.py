@@ -110,6 +110,7 @@ def main(argv=None) -> int:
         kv_endpoints=kv_endpoints,
         sync_dtype=args.sync_dtype or None,
         sync_compress=getattr(args, "sync_compress", "") or None,
+        overlap_sync=getattr(args, "overlap_sync", "") or None,
     )
     # device-level tracing (SURVEY §5.1): a jax.profiler trace of the
     # whole task loop, viewable in TensorBoard/Perfetto/XProf. The

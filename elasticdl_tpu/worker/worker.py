@@ -20,6 +20,7 @@ Re-design of the reference worker
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import os
 import threading
@@ -42,6 +43,7 @@ from elasticdl_tpu.api.model_spec import ModelSpec
 from elasticdl_tpu.common.constants import (
     ENV_BENCH_MFU,
     ENV_BET_PREFETCH,
+    ENV_OVERLAP_SYNC,
     ENV_SCHED_PHASE_SECS,
     ENV_SYNC_COMPRESS,
     ENV_SYNC_DEPTH,
@@ -118,6 +120,20 @@ class EmbeddingInput(NamedTuple):
 
 
 class Worker:
+    # Overlap-plane shared state, declared for edl-lint lock-discipline
+    # (analysis/lock_discipline.py): any access to these attrs outside
+    # `_report_lock` is a lint finding even where write-site inference
+    # alone would not guard them — a bare step-loop read of sync-thread
+    # state is exactly the bug class the overlap plane must exclude.
+    SYNC_GUARDED_ATTRS = {
+        "_report_lock": (
+            "_absorb_staged",
+            "_sync_result",
+            "_base_snapshots",
+            "_spawn_abs",
+        ),
+    }
+
     def __init__(
         self,
         worker_id: int,
@@ -134,6 +150,7 @@ class Worker:
         kv_endpoints=None,  # sharded embedding KV (master/kv_group.py)
         sync_dtype: Optional[str] = None,  # bf16/int8 sync plane w/ EF residual
         sync_compress: Optional[str] = None,  # "topk:<ratio>" sparsification
+        overlap_sync: Optional[str] = None,  # on|off overlap plane gate
     ):
         self._id = worker_id
         self._master = master
@@ -253,6 +270,38 @@ class Worker:
         except ValueError:
             logger.warning("ignoring malformed %s; using 2", ENV_SYNC_DEPTH)
             self._max_inflight_syncs = 2
+        # Overlap plane (--overlap_sync / EDL_OVERLAP_SYNC): on (the
+        # default) keeps window-delta encode/push on pipelined sync
+        # threads, pages model-down in on a background thread that
+        # stages at step boundaries, and runs BET prefetch; off forces
+        # the serial blocking chain (depth 0 = spawn-then-join, no
+        # background pull, no prefetch) — bit-for-bit the pre-overlap
+        # path, for A/B and exactness audits.
+        if overlap_sync is None:
+            overlap_sync = os.environ.get(ENV_OVERLAP_SYNC, "") or "on"
+        overlap_sync = str(overlap_sync).strip().lower()
+        if overlap_sync in ("", "on", "1", "true"):
+            self._overlap_sync = True
+        elif overlap_sync in ("off", "0", "false"):
+            self._overlap_sync = False
+        else:
+            raise ValueError(
+                f"unsupported overlap_sync {overlap_sync!r} (on|off)"
+            )
+        if not self._overlap_sync:
+            self._max_inflight_syncs = 0
+        # Async model-down absorb: a daemon thread pulls the announced
+        # newer model (over shm this maps the prepacked broadcast
+        # segment — a zero-copy page-in) and stages it in
+        # `_absorb_staged` under `_report_lock`; the step loop folds it
+        # in at the next window boundary through the same monotonic
+        # version guard as piggyback absorbs. The staging buffer is
+        # sync-thread state: never read it bare on the step loop (see
+        # SYNC_GUARDED_ATTRS / edl-lint lock-discipline).
+        self._absorb_staged = None  # (shard_versions|None, version, vec, aux)
+        self._bg_pull_thread = None  # in-flight background model pull
+        self._bg_pulls = 0  # background pulls spawned (telemetry/tests)
+        self._staged_applied = 0  # staged models folded in (telemetry)
         self._sync_seq = 0  # spawn counter: tags piggyback results
         self._synced_seq = 0  # highest seq whose delta landed on the PS
         self._sync_epoch = 0  # bumped on reset: invalidates spawned syncs
@@ -1183,18 +1232,34 @@ class Worker:
             # overlap the next window's h2d + compute (pipeline)
             self._check_sync_error()
             self._absorb_sync_result()
+            # fold a background-pulled model in at the boundary (the
+            # async model-down page-in; no-op when nothing is staged)
+            self._apply_staged_model()
         with self._report_lock:
             fresh, version = self._fresh, self._version
         if self._pending_steps == 0 and (
             not fresh or version < task.model_version
         ):
             with self.timers.phase("sync_wait"):
-                self._join_sync()  # model swap: settle the chain first
+                with self._sync_exposed("join"):
+                    self._join_sync()  # model swap: settle chain first
             with self._report_lock:  # re-read: the joined sync may have
                 fresh, version = self._fresh, self._version  # rebased us
             if not fresh or version < task.model_version:
-                if not self.pull_model(max(version, task.model_version)):
-                    self._lazy_init_model(features)
+                # a background pull may already have the model in
+                # flight (kicked at task pickup): ride it instead of
+                # paying a second full pull on the step loop
+                with self._sync_exposed("bg_pull"):
+                    self._join_bg_pull()
+                if self._apply_staged_model():
+                    with self._report_lock:
+                        fresh, version = self._fresh, self._version
+            if not fresh or version < task.model_version:
+                with self._sync_exposed("pull"):
+                    if not self.pull_model(
+                        max(version, task.model_version)
+                    ):
+                        self._lazy_init_model(features)
                 self._opt_state = None  # params swapped: restart opt state
         if self._opt_state is None:
             with self.timers.phase("rebase"):
@@ -1337,7 +1402,8 @@ class Worker:
             # lands before the next lookup. EDL_BET_PREFETCH=0 turns
             # the overlap off (bench A/B knob).
             prefetch_on = (
-                self._max_inflight_syncs > 0
+                self._overlap_sync
+                and self._max_inflight_syncs > 0
                 and os.environ.get(ENV_BET_PREFETCH, "1") != "0"
             )
 
@@ -1682,7 +1748,8 @@ class Worker:
 
         if blocking:
             try:
-                do_sync()
+                with self._sync_exposed("flush"):
+                    do_sync()
             except Exception as e:
                 # the window's work never reached the PS: surface the
                 # covered tasks as failures so the dispatcher requeues
@@ -1706,7 +1773,8 @@ class Worker:
             # their feature buffers + requeue exposure on preemption)
             while len(self._sync_inflight) > self._max_inflight_syncs:
                 with self.timers.phase("sync_wait"):
-                    self._sync_inflight.popleft().join()
+                    with self._sync_exposed("backpressure"):
+                        self._sync_inflight.popleft().join()
 
     def _record_synced_losses(self, losses, loss_h, version):
         """Task losses resolve on the sync thread (batched with the
@@ -1761,6 +1829,7 @@ class Worker:
             # the diverged local params survive the reset
             self._shard_versions = None
             self._sync_result = None
+            self._absorb_staged = None  # staged page-in predates the reset
             self._base_snapshots.clear()
             # lineage dies with the trajectory; the forced re-pull is
             # the next fold point
@@ -1994,6 +2063,190 @@ class Worker:
         self._base_flat = self._base_flat + shift
         if aux:
             self._aux = jax.tree_util.tree_map(jnp.asarray, aux)
+
+    # ------------------------------------------------------- overlap plane
+
+    @contextlib.contextmanager
+    def _sync_exposed(self, reason: str):
+        """Span-mark wall time the STEP LOOP is blocked on the sync
+        plane (joins, blocking pulls, backpressure, drains). These are
+        root spans so `sync_exposed_fraction_from_spans`
+        (obs/critical_path.py) can sum exactly the sync wall that
+        stayed ON the critical path — the quantity the overlap plane
+        exists to shrink, and the bench A/B's acceptance metric."""
+        sp = obs_trace.start_span(
+            "worker.sync_exposed",
+            cat="worker",
+            root=True,
+            args={"worker": self._id, "reason": reason},
+        )
+        try:
+            yield
+        finally:
+            if sp is not None:
+                sp.end()
+
+    def _join_bg_pull(self):
+        """Settle an in-flight background model pull (main thread)."""
+        t = self._bg_pull_thread
+        if t is not None:
+            t.join()
+            self._bg_pull_thread = None
+
+    def _maybe_start_bg_pull(self, min_version: int):
+        """Kick the async model-down page-in: when a task announces a
+        newer version, pull it on a daemon thread while the step loop
+        keeps computing (over shm the pull maps the prepacked broadcast
+        segment — a zero-copy page-in). The result is STAGED, never
+        applied: `_apply_staged_model` folds it in at the next window
+        boundary. No-op when the overlap plane is off, a pull is
+        already in flight, or something is already staged."""
+        if not self._overlap_sync or not self._use_flat():
+            return
+        t = self._bg_pull_thread
+        if t is not None and t.is_alive():
+            return
+        ps = self._ensure_ps()
+        with self._report_lock:
+            if self._absorb_staged is not None:
+                return
+            fresh, cur_version = self._fresh, self._version
+            known = (
+                list(self._shard_versions) if self._shard_versions else None
+            )
+            epoch = self._sync_epoch
+        if fresh and cur_version >= min_version:
+            return  # already current: nothing to page in
+        if cur_version < 0 and ps is None:
+            return  # pre-init: the blocking path owns first contact
+        want_aux = bool(self._aux)  # main-thread snapshot (device state)
+        t = threading.Thread(
+            target=self._bg_pull_once,
+            args=(ps, known, cur_version, want_aux, epoch),
+            daemon=True,
+        )
+        self._bg_pull_thread = t
+        self._bg_pulls += 1
+        t.start()
+
+    def _bg_pull_once(self, ps, known_versions, cur_version, want_aux, epoch):
+        """Background model pull: fetch + stage only — device buffers
+        and version bookkeeping belong to the main thread. Best-effort:
+        a failure here costs nothing (the step loop's blocking pull
+        still exists), so errors log and drop."""
+        sp = obs_trace.start_span(
+            "worker.bg_pull",
+            cat="worker",
+            root=True,
+            args={"worker": self._id},
+        )
+        prev_ctx = obs_trace.bind(sp.ctx) if sp is not None else None
+        try:
+            staged = None
+            if ps is not None:
+                # non-blocking shard fan-out (ps_client.pull_async);
+                # the aux RPC to the master rides alongside it
+                fut = ps.pull_async(
+                    versions=known_versions,
+                    model_dtype=self._model_wire_dtype(),
+                )
+                aux = None
+                if want_aux:
+                    aux = self._master.call("GetAux", {}).get("aux")
+                versions, vec = fut.result()
+                if all(v >= 0 for v in versions) and vec is not None:
+                    staged = (list(versions), min(versions), vec, aux)
+            else:
+                req = {
+                    "version": cur_version,
+                    "method": MethodType.MINIMUM,
+                    "only_if_newer": True,
+                    "flat": True,
+                }
+                resp = self._master.call("GetModel", req)
+                if (
+                    resp.get("version", -1) >= 0
+                    and resp.get("params_flat") is not None
+                ):
+                    staged = (
+                        None,
+                        resp["version"],
+                        resp["params_flat"],
+                        resp.get("aux"),
+                    )
+            if staged is not None:
+                with self._report_lock:
+                    if epoch == self._sync_epoch and staged[1] > self._version:
+                        self._absorb_staged = staged
+        except Exception as e:
+            logger.debug(
+                "worker %d background model pull failed (benign; the "
+                "step loop's blocking pull remains): %s",
+                self._id,
+                e,
+            )
+        finally:
+            if sp is not None:
+                obs_trace.bind(prev_ctx)
+                sp.end()
+
+    def _apply_staged_model(self) -> bool:
+        """Fold a background-pulled model in at a window boundary (main
+        thread, `_pending_steps == 0`). Deferred until the sync chain
+        is settled-or-absorbed: a staged full model REPLACES `_flat`,
+        which would orphan in-flight deltas' base snapshots."""
+        if not self._overlap_sync:
+            return False
+        # lock-free pre-check mirroring _absorb_sync_result: this runs
+        # every window boundary and the empty case must stay free
+        # edl-lint: disable=lock-discipline -- racy read is deliberate; _apply_staged_model_traced re-reads under _report_lock
+        if self._absorb_staged is None:
+            return False
+        t = self._sync_thread
+        if t is not None and t.is_alive():
+            return False  # chain busy: fold at a later boundary
+        with obs_trace.span(
+            "worker.absorb_staged",
+            cat="worker",
+            root=True,
+            args={"worker": self._id},
+        ):
+            return self._apply_staged_model_traced()
+
+    def _apply_staged_model_traced(self) -> bool:
+        with self._report_lock:
+            staged = self._absorb_staged
+            if staged is None:
+                return False
+            if self._sync_result is not None:
+                # an unabsorbed piggyback outranks the page-in: absorb
+                # runs first (caller order); retry next boundary
+                return False
+            versions, version, vec, aux = staged
+            self._absorb_staged = None
+            if version <= self._version:
+                return False  # stale by arrival: same monotonic guard
+                # as _absorb_report_response
+        # device ops outside the lock — the main thread owns _flat
+        self._set_flat(vec, aux)
+        with self._report_lock:
+            self._version = version
+            self._base_version = version
+            self._lineage_version = version
+            self._lineage_anchor_abs = self._own_steps_abs
+            if versions is not None:
+                self._shard_versions = list(versions)
+                self._shard_lineage = list(versions)
+                self._restore_snap = (
+                    list(versions),
+                    np.asarray(vec, dtype=np.float32).copy(),
+                )
+            else:
+                self._shard_lineage = None
+            self._fresh = True
+        self._opt_state = None  # params swapped: rebase at the boundary
+        self._staged_applied += 1
+        return True
 
     def _defer_report(self, task_id: int, err: str):
         """Queue the task's result behind its COVERING sync: the last
@@ -2261,6 +2514,11 @@ class Worker:
         # primary/backup pair of a speculated task
         self._cur_spec_key = task.spec_key
         self._cur_window_idx = 0
+        if self._local_updates:
+            # async model-down: if the task announces a newer version,
+            # start paging it in NOW — the pull overlaps the record
+            # read + parse below instead of stalling the first window
+            self._maybe_start_bg_pull(task.model_version)
         reader = self._readers.get(task.shard_file_name)
         with self.timers.phase("read_records"):
             records = list(reader.read_range(task.start, task.end))
@@ -2679,7 +2937,9 @@ class Worker:
         `run()`'s return would read a pre-sync model)."""
         if not self._local_updates:
             return
-        self._join_sync()
+        self._join_bg_pull()  # settle the async page-in thread too
+        with self._sync_exposed("drain"):
+            self._join_sync()
         if self._pending_steps:
             self._sync_local_updates(blocking=True)
         if self._pending_losses:

@@ -114,6 +114,54 @@ def test_fanin_smoke_n8_shm_beats_uds():
 
 
 @pytest.mark.e2e
+@pytest.mark.perf
+def test_overlap_smoke_window_job_on_vs_off(tmp_path):
+    """The overlap-plane smoke cell riding the fanin-bench CI job: the
+    bench.py window-mode A/B in miniature (8 windows of the cifar CNN
+    over a real localhost RpcServer), overlap_sync off vs on.
+    Exactness (final PS version == sync pushes x window) is asserted in
+    EVERY cell, and the overlap-on sustained img/s must not lose to
+    the serial chain — best-of-3 per mode, because these are short
+    windows on a shared CI host."""
+    from bench import run_job
+    from elasticdl_tpu.models import cifar10_functional_api as model_module
+    from elasticdl_tpu.models.record_codec import (
+        write_synthetic_image_records,
+    )
+
+    path = str(tmp_path / "cifar.rio")
+    write_synthetic_image_records(path, 512, (32, 32, 3), 10)
+    window = 2
+
+    def best(mode):
+        rps = []
+        for _ in range(3):
+            imgs_per_sec, worker, _wall = run_job(
+                model_module,
+                path,
+                512,
+                minibatch=64,
+                records_per_task=128,
+                epochs=1,
+                local_updates=window,
+                grads_to_wait=1,
+                sync_dtype="bfloat16",
+                overlap_sync=mode,
+            )
+            ws = worker.wire_summary
+            assert ws["sync_calls"] == 4  # 8 steps / W=2, no ragged tails
+            assert worker.final_version == ws["sync_calls"] * window, (
+                mode, worker.final_version, ws,
+            )
+            rps.append(imgs_per_sec)
+        return max(rps)
+
+    off_rps = best("off")
+    on_rps = best("on")
+    assert on_rps >= off_rps, (on_rps, off_rps)
+
+
+@pytest.mark.e2e
 @pytest.mark.slow
 def test_fanin_stress_n64_loop_combine_exact():
     """N=64 closed-loop pushers through the loop core with combining:

@@ -595,6 +595,88 @@ def test_lock_discipline_foreign_condition_wait_still_blocks(tmp_path):
     assert "blocking-under-lock" in checks
 
 
+LOCK_DECLARED = """
+import threading
+
+
+class Pipeline:
+    SYNC_GUARDED_ATTRS = {"_lock": ("_staged",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._staged = None
+
+    def stage(self, x):
+        with self._lock:
+            self._staged = x
+
+    def peek(self):
+        return self._staged
+"""
+
+
+def test_lock_discipline_declared_attrs_flag_bare_reads(tmp_path):
+    # the SYNC_GUARDED_ATTRS declaration makes _staged guarded even
+    # when write-site inference alone would agree; the bare peek is a
+    # finding
+    root = _tree(tmp_path, {"mod.py": LOCK_DECLARED})
+    findings = run_analysis(root, rules=["lock-discipline"])
+    assert any(
+        f.check == "unguarded-access" and "_staged" in f.message
+        for f in findings
+    ), findings
+
+
+def test_lock_discipline_declared_attrs_need_no_write_sites(tmp_path):
+    # the declaration's whole point: a background thread writes the
+    # attr through a helper the inferencer can't see (here: no in-class
+    # write under the lock AT ALL), yet the bare read must still flag.
+    # Without the declaration this exact source is silent.
+    src = LOCK_DECLARED.replace(
+        "    def stage(self, x):\n"
+        "        with self._lock:\n"
+        "            self._staged = x\n",
+        "",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["lock-discipline"])
+    assert any(
+        f.check == "unguarded-access" and "_staged" in f.message
+        for f in findings
+    ), findings
+    # negative control: the same class minus the declaration is clean
+    undeclared = src.replace(
+        '    SYNC_GUARDED_ATTRS = {"_lock": ("_staged",)}\n', ""
+    )
+    root2 = _tree(tmp_path / "b", {"mod.py": undeclared})
+    assert run_analysis(root2, rules=["lock-discipline"]) == []
+
+
+def test_lock_discipline_declared_attrs_clean_when_guarded(tmp_path):
+    src = LOCK_DECLARED.replace(
+        "    def peek(self):\n        return self._staged",
+        "    def peek(self):\n        with self._lock:\n"
+        "            return self._staged",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    assert run_analysis(root, rules=["lock-discipline"]) == []
+
+
+def test_lock_discipline_declared_unknown_lock_is_flagged(tmp_path):
+    # declaring a guard the class never creates is a spec bug, not a
+    # silent no-op
+    src = LOCK_DECLARED.replace(
+        'SYNC_GUARDED_ATTRS = {"_lock": ("_staged",)}',
+        'SYNC_GUARDED_ATTRS = {"_lokc": ("_staged",)}',
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["lock-discipline"])
+    assert any(
+        f.check == "bad-guard-declaration" and "_lokc" in f.message
+        for f in findings
+    ), findings
+
+
 def test_suppression_requires_reason(tmp_path):
     src = LOCK_BAD.replace(
         "    def peek(self):",
