@@ -150,6 +150,19 @@ def add_master_args(parser: argparse.ArgumentParser):
         "EDL_FANIN_COMBINE)",
     )
     parser.add_argument(
+        "--num_agg", type=non_neg_int, default=0,
+        help="N>0: interpose N aggregation-tree nodes between the "
+        "workers and the PS shards (agg/): each worker's window-delta "
+        "pushes land on its host aggregator, which presums the cohort "
+        "and forwards ONE combined delta per shard — master-side "
+        "fan-in drops from #workers to #aggregators. Requires "
+        "--num_ps > 0; 0: workers push direct",
+    )
+    parser.add_argument(
+        "--agg_mode", default="process", choices=("process", "inproc"),
+        help="aggregator hosting, like --ps_mode",
+    )
+    parser.add_argument(
         "--num_kv_shards", type=non_neg_int, default=0,
         help="N>0: host the embedding tables behind N KV shard "
         "endpoints (workers look rows up directly, bypassing the "
@@ -354,6 +367,11 @@ def validate_ps_args(args):
     atomic across shards, so num_ps > 0 needs a protocol whose
     application commutes."""
     if getattr(args, "num_ps", 0) <= 0:
+        if getattr(args, "num_agg", 0) > 0:
+            raise ValueError(
+                "--num_agg > 0 requires --num_ps > 0 (the aggregation "
+                "tree forwards to sharded-PS endpoints)"
+            )
         return
     if (
         not args.use_async

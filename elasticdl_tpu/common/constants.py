@@ -82,6 +82,9 @@ ENV_QUEUE_DEPTH_CONTROL = "EDL_QUEUE_DEPTH_CONTROL"
 ENV_FANIN_COMBINE = "EDL_FANIN_COMBINE"
 ENV_FANIN_BATCH = "EDL_FANIN_BATCH"
 ENV_FANIN_WAIT_MS = "EDL_FANIN_WAIT_MS"
+ENV_AGG_BATCH = "EDL_AGG_BATCH"
+ENV_AGG_WAIT_MS = "EDL_AGG_WAIT_MS"
+ENV_AGG_UPSTREAM_TIER = "EDL_AGG_UPSTREAM_TIER"
 ENV_BENCH_LINK_FLOOR = "EDL_BENCH_LINK_FLOOR"
 ENV_OPT_MIRROR_SECS = "EDL_OPT_MIRROR_SECS"
 ENV_BET_PREFETCH = "EDL_BET_PREFETCH"
@@ -211,6 +214,26 @@ ENV_REGISTRY = {
         "a drained batch below EDL_FANIN_BATCH waits this long for "
         "late arrivals before applying (default 0 = off; the batch "
         "window is naturally the previous apply's duration)"
+    ),
+    ENV_AGG_BATCH: (
+        "aggregation tree (agg/): max member pushes per presummed "
+        "cohort an aggregator forwards upstream as one "
+        "PSPushDeltaCombined (default 32)"
+    ),
+    ENV_AGG_WAIT_MS: (
+        "aggregation tree: optional cohort linger in milliseconds — a "
+        "drained cohort below EDL_AGG_BATCH waits this long for late "
+        "host-local arrivals before forwarding (default 0 = off; the "
+        "rendezvous window is naturally the previous forward's "
+        "duration)"
+    ),
+    ENV_AGG_UPSTREAM_TIER: (
+        "aggregation tree: transport tier for the aggregator->PS "
+        "upstream link (default uds = Unix socket when the PS resolves "
+        "local, else grpc; grpc forces sockets; shm/inproc/auto as in "
+        "EDL_TRANSPORT) — the worker->aggregator leg keeps following "
+        "EDL_TRANSPORT, so shm intra-host + sockets upstream is the "
+        "default split"
     ),
     ENV_BENCH_LINK_FLOOR: (
         "bench.py: probed link-bandwidth floor in MB/s below which a "

@@ -267,6 +267,61 @@ class PSPushDeltaRequest(_WireRequest):
 
 
 @dataclasses.dataclass
+class PSPushDeltaCombinedRequest(_WireRequest):
+    """One presummed cohort forwarded by an aggregator node (agg/):
+    `delta` is the f32 presum of the member deltas, `steps` the member
+    sum, and `report_keys` the member dedup keys — the shard applies
+    the combined delta once and registers EVERY member key, so a member
+    replaying direct after an aggregator crash still dedups exactly.
+    A shard that cannot take the batch whole (staleness window active,
+    any member already seen) answers accepted=False and the aggregator
+    decomposes into serial per-member PSPushDelta forwards."""
+
+    delta: Any = None
+    steps: int = 0
+    base_version: int = -1
+    want_model: bool = False
+    report_keys: Any = None  # list[str], one per member
+    model_dtype: Optional[str] = None
+    epoch: int = -1
+
+
+@dataclasses.dataclass
+class AggPushDeltaRequest(_WireRequest):
+    """Worker->aggregator push: PSPushDelta plus the target PS shard
+    and the PS shard's fencing epoch. `epoch` fences the AGGREGATOR's
+    own generation (bumped on relaunch so a stale cohort from before a
+    crash cannot land); `shard_epoch` rides upstream as the combined
+    call's `epoch` so PS fencing is unchanged."""
+
+    delta: Any = None
+    steps: int = 0
+    base_version: int = -1
+    want_model: bool = False
+    report_key: str = ""
+    model_dtype: Optional[str] = None
+    epoch: int = -1
+    shard: int = -1
+    shard_epoch: int = -1
+
+
+@dataclasses.dataclass
+class AggStatsRequest(_WireRequest):
+    """Aggregator counters surface (cohorts, members, forwards,
+    decompositions) — bench/tests read it like PS stats()."""
+
+
+@dataclasses.dataclass
+class AggUpdateUpstreamRequest(_WireRequest):
+    """Master->aggregator re-point after a PS relaunch: the new PS
+    endpoint list (index = shard id). The aggregator rebuilds its
+    upstream clients; in-flight cohorts fail over member-by-member."""
+
+    endpoints: Any = None  # list[str]
+    epoch: int = -1
+
+
+@dataclasses.dataclass
 class PSOptStateRequest(_WireRequest):
     epoch: int = -1
 
@@ -373,6 +428,10 @@ WIRE_SCHEMAS: Dict[str, type] = {
     "PSPull": PSPullRequest,
     "PSPushGrad": PSPushGradRequest,
     "PSPushDelta": PSPushDeltaRequest,
+    "PSPushDeltaCombined": PSPushDeltaCombinedRequest,
+    "AggPushDelta": AggPushDeltaRequest,
+    "AggStats": AggStatsRequest,
+    "AggUpdateUpstream": AggUpdateUpstreamRequest,
     "PSOptState": PSOptStateRequest,
     "PSOptRestore": PSOptRestoreRequest,
     "PSRestoreFromWorker": PSRestoreFromWorkerRequest,

@@ -194,10 +194,14 @@ class CombineBuffer:
         apply_batch: Callable[[List[Member]], None],
         max_batch: Optional[int] = None,
         max_wait_s: Optional[float] = None,
+        span_prefix: str = "fanin",
     ):
         self._apply_batch = apply_batch
         self._max_batch = combine_batch() if max_batch is None else max_batch
         self._max_wait = combine_wait_s() if max_wait_s is None else max_wait_s
+        # span/category namespace: "fanin" on a PS shard, "agg" on an
+        # aggregator node — same stage, distinguishable in the trace
+        self._span_prefix = span_prefix
         self._lock = threading.Lock()  # pending-list bookkeeping, O(1) holds
         self._cond = threading.Condition(self._lock)
         self._pending: Dict[object, List[Member]] = {}
@@ -215,12 +219,14 @@ class CombineBuffer:
             if self._combiner is None:
                 self._combiner = threading.Thread(
                     target=self._combiner_loop,
-                    name="edl-fanin-combiner",
+                    name=f"edl-{self._span_prefix}-combiner",
                     daemon=True,
                 )
                 self._combiner.start()
             self._cond.notify()
-        with obs_trace.span("fanin.park", cat="fanin"):
+        with obs_trace.span(
+            self._span_prefix + ".park", cat=self._span_prefix
+        ):
             answered = member.event.wait(timeout=_MEMBER_WAIT_S)
         if not answered:
             raise RuntimeError("combine-buffer combiner stalled")
@@ -280,8 +286,8 @@ class CombineBuffer:
         # span to the first traced member so the tree stays connected
         parent = next((m.tctx for m in batch if m.tctx is not None), None)
         sp = obs_trace.start_span(
-            "fanin.apply_batch",
-            cat="fanin",
+            self._span_prefix + ".apply_batch",
+            cat=self._span_prefix,
             parent=parent,
             args={"members": len(batch)},
         )

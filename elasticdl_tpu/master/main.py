@@ -134,6 +134,7 @@ def build_master(args, job_type: str, cluster_backend=None):
     store = sparse_opt = None
     kv_group = None
     ps_group = None
+    agg_group = None
     # one try covers EVERYTHING after the first shard spawn: shard
     # subprocesses/pods must not outlive a failed boot, whichever later
     # step (optimizer construction, PS group boot, servicer wiring)
@@ -205,10 +206,36 @@ def build_master(args, job_type: str, cluster_backend=None):
             )
             ps_group.start()
 
+            # Aggregation tree (agg/): host-local presum nodes between
+            # the workers and the shards — master-side fan-in drops
+            # from #workers to #aggregators. Built AFTER the PS group
+            # because the nodes need the upstream shard endpoints.
+            if getattr(args, "num_agg", 0) > 0:
+                if getattr(args, "worker_backend", "") == "k8s":
+                    # no pod builder for aggregators yet: worker pods
+                    # could not reach localhost nodes, so degrade to
+                    # direct pushes rather than strand the tree
+                    logger.warning(
+                        "--num_agg is ignored under worker_backend=k8s "
+                        "(no aggregator pod builder): workers push "
+                        "direct to the PS shards"
+                    )
+                else:
+                    from elasticdl_tpu.agg.group import AggGroup
+
+                    agg_group = AggGroup(
+                        args.num_agg,
+                        list(ps_group.endpoints),
+                        mode=getattr(args, "agg_mode", "process"),
+                    )
+                    agg_group.start()
+
         return _finish_build(args, job_type, spec, ps_group, store,
                              sparse_opt, training, evaluation, prediction,
-                             kv_group=kv_group)
+                             kv_group=kv_group, agg_group=agg_group)
     except Exception:
+        if agg_group is not None:
+            agg_group.stop()
         if ps_group is not None:
             ps_group.stop()
         if kv_group is not None:
@@ -217,7 +244,8 @@ def build_master(args, job_type: str, cluster_backend=None):
 
 
 def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
-                  training, evaluation, prediction, kv_group=None):
+                  training, evaluation, prediction, kv_group=None,
+                  agg_group=None):
     from elasticdl_tpu.master.checkpoint import (
         CheckpointService,
         load_model_file,
@@ -320,6 +348,7 @@ def _finish_build(args, job_type, spec, ps_group, store, sparse_opt,
         staleness_window=args.staleness_window,
         ps_group=ps_group,
         kv_group=kv_group,
+        agg_group=agg_group,
     )
     if ps_group is not None and init_params is not None:
         from elasticdl_tpu.common import codec
@@ -442,6 +471,8 @@ def main(argv=None) -> int:
     if job_type in (JobType.EVALUATION_ONLY, JobType.PREDICTION_ONLY):
         if not servicer.model_initialized():
             logger.error("evaluate/predict jobs need an initialized model")
+            if servicer.agg_group is not None:
+                servicer.agg_group.stop()
             if servicer.ps_group is not None:
                 servicer.ps_group.stop()
             backend.stop()
@@ -585,6 +616,7 @@ def main(argv=None) -> int:
             servicer,
             ps_group=servicer.ps_group,
             kv_group=servicer.kv_group,
+            agg_group=servicer.agg_group,
             on_unrecoverable=lambda kind, sid: ps_dead.set(),
         )
         servicer.set_recovery_plane(recovery)
@@ -645,6 +677,10 @@ def main(argv=None) -> int:
         # shard pods/processes and the watch free BEFORE any
         # TensorBoard keep-alive: serving summaries needs none of them,
         # and keep_running can block for days
+        if servicer.agg_group is not None:
+            # before the PS group: in-flight combined forwards fail
+            # fast against live shards instead of hanging on dead ones
+            servicer.agg_group.stop()
         if servicer.ps_group is not None:
             servicer.ps_group.stop()
         if servicer.kv_group is not None:

@@ -186,6 +186,7 @@ class PSShardServicer:
             "PSPull": self.pull,
             "PSPushGrad": self.push_grad,
             "PSPushDelta": self.push_delta,
+            "PSPushDeltaCombined": self.push_delta_combined,
             "PSOptState": self.opt_state,
             "PSOptRestore": self.opt_restore,
             "GetTrace": self.get_trace,
@@ -583,6 +584,57 @@ class PSShardServicer:
         if base_version + steps != self._version or req.get("want_model"):
             resp["vec"] = self._wire_vec(req)
         return resp
+
+    def push_delta_combined(self, req: dict):
+        """One presummed cohort from an aggregator node (agg/): apply
+        the combined delta once, register EVERY member report_key, and
+        answer with the merged slice the aggregator fans back to all
+        members (their bases fell behind the combined version by
+        construction, exactly like the fan-in fast path above).
+
+        All-or-nothing: if the batch cannot take the fast path —
+        staleness down-weighting active (member-base-dependent), any
+        member key already applied, an intra-batch duplicate, a shape
+        mismatch, or an uninitialized slice — NOTHING is applied and
+        the response says accepted=False with the already-seen keys;
+        the aggregator decomposes into serial per-member PSPushDelta
+        forwards, each deduped individually, so no replay interleaving
+        can double-apply."""
+        self._check_epoch(req)
+        delta = codec.delta_to_f32(req["delta"])
+        keys = [k for k in (req.get("report_keys") or []) if k]
+        with obs_trace.span(
+            "ps.apply",
+            cat="ps",
+            args={"shard": self.shard_id, "kind": "delta_combined"},
+        ):
+            with self._lock:
+                dupes = [k for k in keys if k in self._seen_reports]
+                ok = (
+                    self._vec is not None
+                    and not self._staleness_window
+                    and delta.shape == self._vec.shape
+                    and keys
+                    and len(keys) == len(set(keys))
+                    and not dupes
+                )
+                if not ok:
+                    for k in dupes:
+                        self._duplicate_pushes += 1
+                    return {
+                        "accepted": False,
+                        "version": self._version,
+                        "duplicates": dupes,
+                    }
+                self._combined_batches += 1
+                self._combined_reports += len(keys)
+                self._vec += delta
+                self._version += int(req["steps"])
+                for k in keys:
+                    self._record_applied({"report_key": k})
+                version = self._version
+                vec = self._wire_vec(req)
+        return {"accepted": True, "version": version, "vec": vec}
 
     # -- fan-in combine appliers (fanin.CombineBuffer callbacks) -------------
 

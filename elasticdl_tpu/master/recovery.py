@@ -94,6 +94,7 @@ class RecoveryPlane:
         servicer,
         ps_group=None,
         kv_group=None,
+        agg_group=None,
         poll_interval: float = 0.25,
         opt_mirror_interval: Optional[float] = None,
         opt_mirror_ring: int = 4,
@@ -103,6 +104,13 @@ class RecoveryPlane:
         self._servicer = servicer
         self._ps_group = ps_group
         self._kv_group = kv_group
+        # aggregation tree (agg/): aggregator nodes are STATELESS, so
+        # their recovery rung is relaunch-not-restore — detect, bump
+        # the fencing generation, boot a fresh node, re-advertise. No
+        # uploads, no mirrors. Workers bypass a dead aggregator the
+        # moment a push fails (rpc/ps_client.py) and re-arm from
+        # GetPSConfig once the slot clears `recovering["agg"]`.
+        self._agg_group = agg_group
         self._poll_interval = poll_interval
         if opt_mirror_interval is None:
             import os
@@ -121,7 +129,11 @@ class RecoveryPlane:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._states: Dict[tuple, str] = {}  # (kind, shard_id) -> state
-        self._recovering: Dict[str, set] = {"ps": set(), "kv": set()}
+        self._recovering: Dict[str, set] = {
+            "ps": set(),
+            "kv": set(),
+            "agg": set(),
+        }
         # shard_id -> (version, vec): best restore candidate so far
         self._uploads: Dict[int, tuple] = {}
         # shard_id -> deque of optimizer-state leaves (newest last)
@@ -185,6 +197,7 @@ class RecoveryPlane:
             return {
                 "ps": sorted(self._recovering["ps"]),
                 "kv": sorted(self._recovering["kv"]),
+                "agg": sorted(self._recovering["agg"]),
             }
 
     def states(self) -> Dict[tuple, str]:
@@ -239,11 +252,18 @@ class RecoveryPlane:
                 if self._kv_group is not None:
                     for i, rc in self._kv_group.poll_dead():
                         self._begin("kv", i, f"process exit rc={rc}")
+                if self._agg_group is not None:
+                    for i, rc in self._agg_group.poll_dead():
+                        self._begin("agg", i, f"process exit rc={rc}")
             except Exception:
                 logger.exception("recovery monitor poll failed")
 
     def _begin(self, kind: str, shard_id: int, why: str):
-        group = self._ps_group if kind == "ps" else self._kv_group
+        group = {
+            "ps": self._ps_group,
+            "kv": self._kv_group,
+            "agg": self._agg_group,
+        }.get(kind)
         if group is None:
             return
         with self._lock:
@@ -286,6 +306,8 @@ class RecoveryPlane:
         try:
             if kind == "ps":
                 self._recover_ps(shard_id)
+            elif kind == "agg":
+                self._recover_agg(shard_id)
             else:
                 self._recover_kv(shard_id)
         except Exception:
@@ -405,7 +427,31 @@ class RecoveryPlane:
                 )
         finally:
             client.close()
+        # the aggregator nodes hold upstream clients to the old
+        # endpoint: re-point them at the moved shard (best-effort — a
+        # node that misses it fails its next forward and the members
+        # replay direct, which still converges)
+        if self._agg_group is not None:
+            try:
+                self._agg_group.update_upstream(list(group.endpoints))
+            except Exception:
+                logger.exception(
+                    "aggregator upstream re-point after PS shard %d "
+                    "recovery failed", shard_id,
+                )
         self._finish("ps", shard_id, generation)
+
+    def _recover_agg(self, shard_id: int):
+        """Relaunch-not-restore: an aggregator holds no model state, so
+        recovery is just a fenced relaunch — the generation bump means
+        a cohort member parked in the dead node can never land twice
+        (its replayed direct push is the only one the PS dedup ring
+        will apply)."""
+        group = self._agg_group
+        with self._lock:
+            self._states[("agg", shard_id)] = RELAUNCHING
+        group.relaunch_shard(shard_id)
+        self._finish("agg", shard_id, group.generations[shard_id])
 
     def _recover_kv(self, shard_id: int):
         from elasticdl_tpu.rpc.client import RpcClient

@@ -86,6 +86,7 @@ class MasterServicer:
         staleness_window: int = 0,
         ps_group=None,
         kv_group=None,
+        agg_group=None,
     ):
         # Sharded PS (master/ps_group.py): the dense model lives behind
         # N shard endpoints and workers push slices there directly; the
@@ -100,6 +101,11 @@ class MasterServicer:
         # ShardedEmbeddingStore client over them, and workers discover
         # the endpoints via GetPSConfig to hit the shards directly.
         self._kv_group = self.kv_group = kv_group
+        # Aggregation tree (agg/): host-local presum aggregators ahead
+        # of the PS shards; workers discover their aggregator via
+        # GetPSConfig (worker_id % len(agg_endpoints)) and fall back to
+        # direct shard pushes when the list is empty.
+        self._agg_group = self.agg_group = agg_group
         self._lock = threading.Lock()
         # Sparse applies serialize among THEMSELVES (read-modify-write
         # per id) but run OUTSIDE self._lock: with a KV-shard-backed
@@ -682,9 +688,17 @@ class MasterServicer:
             if self._kv_group is not None
             else []
         )
+        agg = self._agg_group.endpoints if self._agg_group is not None else []
+        agg_gens = (
+            list(self._agg_group.generations)
+            if self._agg_group is not None
+            else []
+        )
         plane = self._recovery_plane
         recovering = (
-            plane.status() if plane is not None else {"ps": [], "kv": []}
+            plane.status()
+            if plane is not None
+            else {"ps": [], "kv": [], "agg": []}
         )
         if self._ps_group is None:
             return {
@@ -693,6 +707,8 @@ class MasterServicer:
                 "kv_endpoints": kv,
                 "ps_generations": [],
                 "kv_generations": kv_gens,
+                "agg_endpoints": agg,
+                "agg_generations": agg_gens,
                 "recovering": recovering,
             }
         with self._lock:
@@ -710,6 +726,8 @@ class MasterServicer:
             "kv_endpoints": kv,
             "ps_generations": list(self._ps_group.generations),
             "kv_generations": kv_gens,
+            "agg_endpoints": agg,
+            "agg_generations": agg_gens,
             "recovering": recovering,
         }
 

@@ -54,11 +54,12 @@ def spawn_shard_processes(
         # accelerator (the entrypoints also pin the backend themselves —
         # the image's sitecustomize overrides the env var)
         env["JAX_PLATFORMS"] = "cpu"
-        # chaos scoping: "ps"/"kv" role + shard id for an inherited
-        # EDL_CHAOS_SPEC (inert when chaos is off)
+        # chaos scoping: "ps"/"kv"/"agg" role + shard id for an
+        # inherited EDL_CHAOS_SPEC (inert when chaos is off)
         from elasticdl_tpu.rpc.chaos import chaos_env_for
 
-        role = "kv" if "kv" in entry_module.rsplit(".", 1)[-1] else "ps"
+        leaf = entry_module.rsplit(".", 1)[-1]
+        role = "kv" if "kv" in leaf else ("agg" if "agg" in leaf else "ps")
         env.update(chaos_env_for(role, i))
         # transport tiers: EDL_TRANSPORT inherits via the env copy, but
         # the UDS socket DIR must be pinned explicitly — parent and

@@ -62,6 +62,13 @@ METRIC_REGISTRY: Dict[str, str] = {
     "edl_kv_generation": "KV shard fencing generation.",
     "edl_kv_lookups_total": "KV rows looked up, per shard.",
     "edl_kv_updates_total": "KV rows updated, per shard.",
+    # aggregator counters (agg/aggregator.AggregatorServicer.stats)
+    "edl_agg_members_total": "Worker pushes accepted by an aggregator.",
+    "edl_agg_cohorts_total": "Combined cohorts forwarded upstream by an aggregator.",
+    "edl_agg_singles_total": "k=1 passthrough forwards by an aggregator.",
+    "edl_agg_decompositions_total": "Rejected combined batches unwound to per-member forwards.",
+    "edl_agg_upstream_errors_total": "Upstream forwards that errored their parked members.",
+    "edl_agg_generation": "Aggregator fencing generation.",
     # worker phase timers (common/phase_timers.PhaseTimers)
     "edl_phase_seconds_total": "Wall seconds spent in a worker phase.",
     "edl_phase_count_total": "Entries into a worker phase.",

@@ -47,6 +47,7 @@ class RpcClient:
         policy: Optional[RetryPolicy] = None,
         breaker: Optional[CircuitBreaker] = None,
         fault_plan: Optional[chaos.FaultPlan] = None,
+        transport: Optional[str] = None,
     ):
         channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
         plan = fault_plan if fault_plan is not None else chaos.FaultPlan.from_env()
@@ -61,7 +62,13 @@ class RpcClient:
         # chaos counters advance identically whichever tier serves.
         from elasticdl_tpu.rpc import transport as transport_mod
 
-        self._transport = transport_mod.select_transport(addr, fault_plan=plan)
+        # `transport` pins this client's tier regardless of the ambient
+        # EDL_TRANSPORT mode (per-link selection: the aggregation tree
+        # keeps shm for worker->aggregator while pinning uds/grpc for
+        # aggregator->PS); None = the env mode as before.
+        self._transport = transport_mod.select_transport(
+            addr, fault_plan=plan, tier=transport
+        )
         self._policy = policy if policy is not None else RetryPolicy.from_env()
         self._breaker = breaker if breaker is not None else CircuitBreaker(addr)
         self._calls: dict[str, Any] = {}

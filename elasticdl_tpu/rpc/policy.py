@@ -92,6 +92,19 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
         "PSPushDelta",
         "PSOptState",
         "PSOptRestore",
+        # aggregation tree (agg/): AggPushDelta is the worker-facing
+        # push surface — the PS-side per-member report_key dedup makes
+        # a resend exact even if the first attempt was absorbed into a
+        # cohort that already forwarded. AggStats is a read;
+        # AggUpdateUpstream overwrites one endpoint list (LWW).
+        # PSPushDeltaCombined is deliberately NOT here: a combined
+        # forward carries k member keys, and a blind resend could
+        # interleave with members replaying direct — the aggregator
+        # handles forward failure by erroring its members instead, who
+        # each retry under their own key.
+        "AggPushDelta",
+        "AggStats",
+        "AggUpdateUpstream",
         # recovery plane (master RPC): the master keeps at most one
         # restore candidate per (worker, shard) — a resend overwrites
         # it with the identical payload (master/recovery.py)
@@ -121,7 +134,7 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
 #: a keyless push whose first attempt WAS applied would double-apply on
 #: retry.
 DEDUP_KEYED_METHODS: FrozenSet[str] = frozenset(
-    {"PSPushGrad", "PSPushDelta", "ReportLocalUpdate"}
+    {"PSPushGrad", "PSPushDelta", "ReportLocalUpdate", "AggPushDelta"}
 )
 
 

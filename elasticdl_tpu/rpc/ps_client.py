@@ -9,6 +9,7 @@ whole point of sharding the PS; SURVEY §7.3 item 3). Slices follow
 
 from __future__ import annotations
 
+import threading
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
@@ -18,8 +19,11 @@ import grpc
 import numpy as np
 
 from elasticdl_tpu.common import codec
+from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.master.ps_shard import slice_boundaries
 from elasticdl_tpu.rpc.client import RpcClient
+
+logger = get_logger(__name__)
 
 
 class ShardedPS:
@@ -50,6 +54,67 @@ class ShardedPS:
         # deadlock at num_shards in-flight pulls (classic nested-submit
         # starvation). Lazy: most callers never go async.
         self._async_pool = None
+        # aggregation tree (agg/): when armed, window-delta pushes
+        # route through the host aggregator (AggPushDelta) instead of
+        # direct to the shards — one client per shard so the per-shard
+        # fan-out keeps its connection parallelism on the shm tier.
+        # Any agg-path failure drops the route and replays direct under
+        # the SAME report_key (shard dedup keeps versions exact); the
+        # worker re-arms from GetPSConfig once `agg_dropped` reports it.
+        self._agg_lock = threading.Lock()
+        self._agg_clients: Optional[List[RpcClient]] = None
+        self._agg_endpoint: Optional[str] = None
+        self._agg_generation = -1
+        self._agg_graveyard: List[RpcClient] = []
+        self.agg_dropped = False
+
+    # -- aggregation tree ----------------------------------------------------
+
+    def set_aggregator(self, endpoint: str, generation: int = -1):
+        """Arm the aggregator route: pushes go worker->agg->PS. A
+        re-arm at the same (endpoint, generation) is a no-op so callers
+        can re-assert from every GetPSConfig poll."""
+        with self._agg_lock:
+            if (
+                self._agg_clients is not None
+                and self._agg_endpoint == endpoint
+                and self._agg_generation == int(generation)
+            ):
+                return
+            if self._agg_clients is not None:
+                self._agg_graveyard.extend(self._agg_clients)
+            self._agg_clients = [
+                RpcClient(endpoint) for _ in self.endpoints
+            ]
+            self._agg_endpoint = endpoint
+            self._agg_generation = int(generation)
+            self.agg_dropped = False
+
+    def clear_aggregator(self):
+        """Disarm the aggregator route (pushes go direct). Clients are
+        parked, not closed: sibling fan-out threads may still be
+        mid-call on them — they drain at close()."""
+        with self._agg_lock:
+            if self._agg_clients is not None:
+                self._agg_graveyard.extend(self._agg_clients)
+            self._agg_clients = None
+            self._agg_endpoint = None
+            self._agg_generation = -1
+
+    def _drop_aggregator(self, shard: int, exc: BaseException):
+        with self._agg_lock:
+            if self._agg_clients is None:
+                return  # a sibling shard's failure already dropped it
+            logger.warning(
+                "aggregator %s failed on shard %d (%s); falling back "
+                "to direct PS pushes",
+                self._agg_endpoint, shard, exc,
+            )
+            self._agg_graveyard.extend(self._agg_clients)
+            self._agg_clients = None
+            self._agg_endpoint = None
+            self._agg_generation = -1
+            self.agg_dropped = True
 
     @property
     def num_shards(self) -> int:
@@ -264,6 +329,11 @@ class ShardedPS:
 
         # shard-side dedup: retry-safe (speculation-safe when pinned)
         report_key = report_key or uuid.uuid4().hex
+        # snapshot the agg route ONCE per fan-out so every shard of one
+        # logical push takes the same path decision
+        with self._agg_lock:
+            agg_clients = self._agg_clients
+            agg_generation = self._agg_generation
 
         def do(c, i):
             s, e = self.bounds[i]
@@ -276,6 +346,36 @@ class ShardedPS:
             }
             if model_dtype:
                 req["model_dtype"] = model_dtype
+            if agg_clients is not None:
+                # tree route: same slice, same report_key, plus the
+                # target shard + the shard's fencing epoch for the
+                # upstream forward; `epoch` fences the AGGREGATOR's
+                # generation (agg/aggregator.py)
+                try:
+                    return agg_clients[i].call(
+                        "AggPushDelta",
+                        {
+                            "delta": req["delta"],
+                            "steps": steps,
+                            "base_version": base_versions[i],
+                            "want_model": want_model,
+                            "report_key": report_key,
+                            "model_dtype": model_dtype,
+                            "shard": i,
+                            "shard_epoch": (
+                                self.generations[i]
+                                if self.generations is not None
+                                else -1
+                            ),
+                            "epoch": agg_generation,
+                        },
+                    )
+                except Exception as exc:  # noqa: BLE001 - any agg-path
+                    # failure (fenced, dead, upstream error) means
+                    # bypass: replay DIRECT under the same report_key —
+                    # shard dedup absorbs whatever the cohort already
+                    # landed, so versions stay exact
+                    self._drop_aggregator(i, exc)
             return c.call("PSPushDelta", self._stamp_epoch(req, i))
 
         resps = self._map(do)
@@ -387,4 +487,10 @@ class ShardedPS:
         if self._async_pool is not None:
             self._async_pool.shutdown(wait=False)
         for c in self._clients:
+            c.close()
+        with self._agg_lock:
+            agg = list(self._agg_clients or []) + self._agg_graveyard
+            self._agg_clients = None
+            self._agg_graveyard = []
+        for c in agg:
             c.close()

@@ -1525,11 +1525,21 @@ def endpoint_is_local(addr: str) -> bool:
         return False
 
 
-def select_transport(addr: str, fault_plan=None):
+def select_transport(addr: str, fault_plan=None, tier: Optional[str] = None):
     """The fast-path transport for `addr` under the configured mode, or
     None for plain gRPC. Never raises: any doubt (remote host, no
-    socket file, unparseable endpoint) means gRPC."""
+    socket file, unparseable endpoint) means gRPC.
+
+    `tier` overrides the process-wide EDL_TRANSPORT mode for ONE link —
+    the aggregation tree uses it to pin the aggregator->PS upstream leg
+    to uds/grpc while the worker->aggregator leg keeps the ambient shm
+    mode (agg/aggregator.py). Unknown values fall back to the env mode
+    rather than raising (same never-raises contract)."""
     mode = transport_mode()
+    if tier is not None:
+        tier = tier.strip().lower()
+        if tier in TRANSPORT_TIERS or tier == "auto":
+            mode = tier
     if mode == TRANSPORT_GRPC:
         return None
     port = _endpoint_port(addr)

@@ -433,6 +433,7 @@ class Worker:
             # Best-effort — a master that predates the field just
             # leaves us UNFENCED (epoch -1 always passes).
             generations = None
+            cfg = {}
             try:
                 cfg = self._master.call("GetPSConfig", {})
                 gens = cfg.get("ps_generations")
@@ -445,7 +446,29 @@ class Worker:
                 int(self._flat.size),
                 generations=generations,
             )
+            self._arm_aggregator(cfg)
         return self._ps
+
+    def _arm_aggregator(self, cfg: dict):
+        """Point the sharded-PS client at this worker's aggregation-tree
+        node (agg/aggregator.py), resolved worker_id-mod-#aggregators so
+        co-hosted workers share one node. No-op when the master doesn't
+        advertise a tree; a slot mid-relaunch stays direct-to-PS (the
+        push path is identical either way — same report_keys, same
+        versions) and re-arms at the next task boundary."""
+        if self._ps is None:
+            return
+        eps = cfg.get("agg_endpoints") or []
+        gens = cfg.get("agg_generations") or []
+        agg_rec = (cfg.get("recovering") or {}).get("agg") or []
+        if not eps:
+            self._ps.clear_aggregator()
+            return
+        idx = self._id % len(eps)
+        if idx in agg_rec:
+            return  # slot fenced mid-relaunch: keep pushing direct
+        gen = gens[idx] if idx < len(gens) else -1
+        self._ps.set_aggregator(eps[idx], gen)
 
     def pull_model(self, min_version: int = -1, method: str = MethodType.MINIMUM):
         """reference: worker.py:103-124 (var assign becomes pytree swap)."""
@@ -1937,6 +1960,10 @@ class Worker:
                 continue
             if self._ps is not None and eps:
                 self._ps.update_endpoints(eps, gens)
+                # the tree may have been re-pointed (or relaunched)
+                # alongside the PS recovery — re-resolve it from the
+                # same config snapshot the endpoints came from
+                self._arm_aggregator(cfg)
             if self._kv is not None and kv_eps:
                 self._kv.update_endpoints(kv_eps, kv_gens)
             if reset:
@@ -2514,6 +2541,14 @@ class Worker:
         # primary/backup pair of a speculated task
         self._cur_spec_key = task.spec_key
         self._cur_window_idx = 0
+        if self._ps is not None and self._ps.agg_dropped:
+            # an aggregator died mid-run and pushes fell back to
+            # direct-to-PS; task boundaries are the safe point to
+            # re-resolve the (relaunched) tree — no window is in flight
+            try:
+                self._arm_aggregator(self._master.call("GetPSConfig", {}))
+            except Exception:
+                pass  # stay direct; retried next boundary
         if self._local_updates:
             # async model-down: if the task announces a newer version,
             # start paging it in NOW — the pull overlaps the record

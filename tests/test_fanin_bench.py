@@ -52,8 +52,15 @@ def test_fanin_smoke_suite_json_contract():
         grid=(("inproc", ("topk",)),),
         warmup_s=WARMUP_S,
         window_s=WINDOW_S,
+        tree_cell=(8, 2),
     )
     cell = suite["cells"]["inproc"]["topk"]["8"]
+    # the aggregation-tree column rides the same record
+    tree = suite["tree"]
+    assert tree["tree"]["core"] == "tree"
+    assert tree["tree"]["sync_round"]["upstream_combined_calls"] == 2
+    assert tree["flat_loop_combine"]["core"] == "loop_combine"
+    assert tree["speedup"] > 0
     assert cell["blocking"]["reports_per_sec"] > 0
     assert cell["loop_combine"]["reports_per_sec"] > 0
     assert cell["speedup"] > 0
@@ -175,3 +182,55 @@ def test_fanin_stress_n64_loop_combine_exact():
     assert cell["version"] == cell["applied_pushes"] > 0
     # at 64 concurrent pushers batches must be deep, not pairs
     assert cell["combine_ratio"] > 2.0
+
+
+@pytest.mark.e2e
+@pytest.mark.perf
+def test_tree_smoke_n64_h4_beats_flat_and_collapses_fanin():
+    """The aggregation-tree acceptance cell (agg/): N=64 workers
+    through H=4 host-local aggregator subprocesses vs the same 64
+    direct on the flat loop+combine core.
+
+    The contract, all on one cell:
+    - degree reduction counted on the master's own wire stats: one
+      synchronized all-worker round lands as EXACTLY H combined
+      upstream calls (not N singles), at version == N;
+    - zero intra-host socket-tier bytes: the worker-facing side rode
+      the shm ring only — no grpc/uds fallback on any aggregator;
+    - the tree's sustained master-side reports/s beats flat
+      loop+combine at equal N (host-local presum + broadcast fan-back
+      take the per-member bytes off the master's link);
+    - exactness rides both cells: version == applied pushes.
+    """
+    from bench_fanin import run_tree_cell
+
+    flat = run_cell(
+        64, "shm", dispatch="loop", combine=True, wire="topk",
+        warmup_s=0.3, window_s=1.0,
+    )
+    tree = run_tree_cell(64, 4, warmup_s=0.3, window_s=1.0)
+
+    for cell in (flat, tree):
+        assert cell["version"] == cell["applied_pushes"] > 0
+    # master fan-in degree: #hosts, not #workers
+    sync = tree["sync_round"]
+    assert sync["upstream_combined_calls"] == 4, sync
+    assert sync["upstream_single_calls"] == 0, sync
+    assert sync["version"] == 64, sync
+    # intra-host leg stayed on the ring: zero socket-tier bytes
+    tr = tree["agg_transports"]
+    assert tr.get("shm", {}).get("calls", 0) > 0, tr
+    for socket_tier in ("grpc", "uds"):
+        row = tr.get(socket_tier, {})
+        assert (
+            row.get("bytes_sent", 0) + row.get("bytes_received", 0)
+        ) == 0, (socket_tier, tr)
+    # the upstream leg went over the configured socket tier, and the
+    # aggregation actually happened (deep cohorts, no upstream errors)
+    assert tree["cohorts_forwarded"] > 0
+    assert tree["upstream_errors"] == 0
+    assert tree["combine_ratio"] > 2.0
+    # the headline: tree >= flat on sustained master-side reports/s
+    assert tree["reports_per_sec"] >= flat["reports_per_sec"], (
+        tree["reports_per_sec"], flat["reports_per_sec"],
+    )

@@ -311,7 +311,7 @@ def test_ps_failover_unrecoverable_without_upload():
             plane.on_shard_failure("ps", 0)
             _wait_until(lambda: failed, what="unrecoverable callback")
             assert failed == [("ps", 0)]
-            assert plane.status() == {"ps": [], "kv": []}
+            assert plane.status() == {"ps": [], "kv": [], "agg": []}
         finally:
             plane.stop()
     finally:
@@ -438,7 +438,7 @@ def test_shard_version_floor_mirror_and_ps_config():
         cfg = servicer.get_ps_config({})
         assert cfg["endpoints"] == group.endpoints
         assert cfg["ps_generations"] == [0, 0]
-        assert cfg["recovering"] == {"ps": [], "kv": []}
+        assert cfg["recovering"] == {"ps": [], "kv": [], "agg": []}
 
         class _Plane:
             def status(self):

@@ -303,9 +303,14 @@ def test_autoscaler_resizes_preserve_exactness(tmp_path, sync_dtype):
 # -- two-job QoS contention over ProcessBackend (slow tier) ------------------
 
 
-def _start_process_job(tmp, tag, n_records, num_epochs, num_workers, qos):
+def _start_process_job(
+    tmp, tag, n_records, num_epochs, num_workers, qos, envs=None
+):
     """One window-mode ProcessBackend job against its own master.
-    Returns the live handles the contention test choreographs."""
+    Returns the live handles the contention test choreographs.
+    `envs` merges extra environment onto the spawned workers (e.g. an
+    EDL_CHAOS_SPEC so faults scope to ONE job's workers, not the
+    whole test process)."""
     from elasticdl_tpu.common.args import master_parser, worker_forward_args
     from elasticdl_tpu.master.main import build_master
     from elasticdl_tpu.rpc.server import RpcServer
@@ -344,7 +349,7 @@ def _start_process_job(tmp, tag, n_records, num_epochs, num_workers, qos):
         worker_argv_fn=lambda wid: worker_forward_args(
             args, wid, f"localhost:{server.port}"
         ),
-        envs={"JAX_PLATFORMS": "cpu"},
+        envs={"JAX_PLATFORMS": "cpu", **(envs or {})},
         max_relaunches=4,
     )
     return {
@@ -430,6 +435,112 @@ def test_two_job_contention_guaranteed_preempts_best_effort(tmp_path):
         assert snap["policy_stops"] == 1
         assert snap["scale_downs"] == 1
         # a policy stop is not a failure: no relaunch was spent on it
+        assert snap["relaunches"] == 0
+    finally:
+        _stop_process_job(be)
+
+
+@pytest.mark.e2e
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_preemption_drain_under_chaos_stays_exact(tmp_path):
+    """Chaos composed with the QoS drain window — the hole the PR-8
+    suite left open: its fault plans always ran against a steady fleet,
+    never while a policy drain was in flight. Here the best-effort
+    job's workers run under an armed FaultPlan for their WHOLE life —
+    every master-bound window report pays an injected client-side
+    latency, and every 4th one is a `drop` (the master APPLIES the
+    update, the response is discarded, the worker retries under the
+    same report_key) — so when the guaranteed job's capacity request
+    preempts a worker, the victim's final drain report is itself a
+    faulted call: the drain window and the fault plan provably overlap.
+    The bar is unchanged from the fault-free run: both jobs finish at
+    their exact fault-free versions, the policy stop spends no
+    relaunch, and the dedup ring (not luck) absorbed the replays."""
+    tmp = str(tmp_path)
+    arbiter = PriorityArbiter(capacity=2)
+
+    chaos_spec = (
+        '{"seed": 13, "faults": ['
+        '{"kind": "latency", "methods": ["ReportLocalUpdate"],'
+        ' "roles": ["worker"], "side": "client", "latency_ms": 150},'
+        '{"kind": "drop", "methods": ["ReportLocalUpdate"],'
+        ' "roles": ["worker"], "side": "client", "every": 4}'
+        "]}"
+    )
+    from elasticdl_tpu.common.constants import (
+        ENV_CHAOS_SPEC,
+        ENV_RPC_BACKOFF,
+        ENV_RPC_RETRIES,
+    )
+
+    chaos_envs = {
+        ENV_CHAOS_SPEC: chaos_spec,
+        # dropped reports must replay quickly, not ride the production
+        # backoff ladder through the drain window
+        ENV_RPC_RETRIES: "4",
+        ENV_RPC_BACKOFF: "0.05",
+    }
+
+    # 256 records / 32 per task x 4 epochs = 32 task execs, 2 steps each
+    be = _start_process_job(
+        tmp, "be", 256, 4, 2, "best-effort", envs=chaos_envs
+    )
+    handle_be = arbiter.register(
+        "be", "best-effort", preempt_cb=be["manager"].scale_down
+    )
+    assert arbiter.request(handle_be, 2) == 2
+    be["manager"].start_workers()
+    try:
+        _poll(
+            lambda: be["dispatcher"].completed_records() >= 32,
+            180,
+            "best-effort job made no progress under chaos",
+        )
+
+        # the preemption runs synchronously inside request(): the
+        # victim drains its in-flight task THROUGH the armed fault
+        # plan (its final window report is latency-injected, and may
+        # be a drop-replay) before the token frees
+        handle_g = arbiter.register("g", "guaranteed")
+        assert arbiter.request(handle_g, 1) == 1
+        assert arbiter.stats()["preemptions"] == 1
+        assert handle_be.granted == 1 and handle_be.preempted == 1
+
+        # 128 records / 32 per task x 2 epochs = 8 task execs; the
+        # guaranteed job runs fault-free — chaos is scoped to the
+        # best-effort job's worker processes by env, not global
+        g = _start_process_job(tmp, "g", 128, 2, 1, "guaranteed")
+        g["manager"].start_workers()
+        try:
+            _poll(
+                lambda: g["dispatcher"].finished(),
+                300,
+                "guaranteed job stuck",
+            )
+            _poll(
+                lambda: be["dispatcher"].finished(),
+                300,
+                "best-effort job stuck after chaos drain",
+            )
+            assert not g["dispatcher"].has_failed_tasks()
+            assert not be["dispatcher"].has_failed_tasks()
+            # exact fault-free versions on BOTH sides: every record
+            # exactly once, every dropped report's replay absorbed
+            assert g["dispatcher"].completed_records() == 256
+            assert g["servicer"].version == 16
+            assert be["dispatcher"].completed_records() == 1024
+            assert be["servicer"].version == 64
+            # the drops really fired and really were absorbed by the
+            # report_key ring — exactness was defended, not untested
+            sched = be["servicer"].get_sched_stats({})
+            assert sched["duplicate_local_updates"] >= 1, sched
+        finally:
+            _stop_process_job(g)
+        snap = be["manager"].snapshot()
+        assert snap["policy_stops"] == 1
+        assert snap["scale_downs"] == 1
+        # a policy stop under chaos is still not a failure
         assert snap["relaunches"] == 0
     finally:
         _stop_process_job(be)

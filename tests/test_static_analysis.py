@@ -450,6 +450,146 @@ def test_transport_shm_ring_server_dispatch_bypass(tmp_path):
     assert "ShmServer" in bypass[0].message
 
 
+# aggregator forward-path twin (agg/aggregator.py): workers push on the
+# dedup-keyed AggPushDelta surface through a dispatcher-routed listener,
+# the presummed cohort forwards upstream as ONE PSPushDeltaCombined
+# frame (member report_keys riding along) over a chaos-hooked client
+# tier — the two places the tree could silently drop out of the fault
+# plane are the ring listener and the upstream hop, so both get pos/neg
+# fixtures here
+AGG_FORWARD_GOOD = """
+IDEMPOTENT_METHODS = frozenset({"AggPushDelta"})
+DEDUP_KEYED_METHODS = {"AggPushDelta"}
+
+TRANSPORT_TIERS = ("uds", "inproc")
+
+
+def transport_faults_before(plan, method, side):
+    return []
+
+
+def transport_faults_after(after, method):
+    pass
+
+
+class ServerDispatcher:
+    def dispatch(self, method, request_bytes, transport):
+        after = transport_faults_before(None, method, "server")
+        resp = b""
+        transport_faults_after(after, method)
+        return resp
+
+
+class UpstreamTransport:
+    name = "uds"
+
+    def call(self, method, payload, timeout):
+        after = transport_faults_before(None, method, "client")
+        transport_faults_after(after, method)
+        return b""
+
+
+class AggRingServer:
+    def serve_conn(self, dispatcher, method, body):
+        return dispatcher.dispatch(method, body, "uds")
+
+
+class PSShardServicer:
+    def handlers(self):
+        return {"PSPushDeltaCombined": self.push_delta_combined}
+
+    def push_delta_combined(self, req):
+        return {"accepted": True}
+
+
+class AggregatorServicer:
+    def handlers(self):
+        return {"AggPushDelta": self.push_delta}
+
+    def push_delta(self, req):
+        return {"k": req.get("report_key")}
+
+    def forward(self, upstream, keys):
+        upstream.call(
+            "PSPushDeltaCombined",
+            {"delta": b"", "steps": 2, "report_keys": keys},
+        )
+
+
+def worker_push(client, key):
+    client.call("AggPushDelta", {"delta": b"", "report_key": key})
+"""
+
+
+def test_agg_forward_path_clean(tmp_path):
+    """Negative fixture: the conforming aggregator forward path —
+    keyed member pushes, dispatcher-routed worker-facing listener,
+    chaos-hooked upstream tier, combined frame with a registered
+    handler — is lint-silent."""
+    root = _tree(tmp_path, {"agg.py": AGG_FORWARD_GOOD})
+    assert run_analysis(root, rules=["rpc-conformance"]) == []
+
+
+def test_agg_forward_upstream_chaos_bypass(tmp_path):
+    # the upstream hop skips FaultPlan injection: the one combined
+    # frame per cohort is exactly the call chaos e2e must reach
+    src = AGG_FORWARD_GOOD.replace(
+        'after = transport_faults_before(None, method, "client")\n'
+        "        transport_faults_after(after, method)",
+        "pass",
+    )
+    root = _tree(tmp_path, {"agg.py": src})
+    findings = run_analysis(root, rules=["rpc-conformance"])
+    bypass = [f for f in findings if f.check == "transport-chaos-bypass"]
+    assert len(bypass) == 1, findings
+    assert "UpstreamTransport" in bypass[0].message
+
+
+def test_agg_forward_listener_dispatch_bypass(tmp_path):
+    # an aggregator ring listener decoding worker pushes into its own
+    # method table instead of ServerDispatcher — admission queues,
+    # fencing, and server-side chaos would all silently vanish from
+    # the worker-facing leg
+    src = AGG_FORWARD_GOOD.replace(
+        'return dispatcher.dispatch(method, body, "uds")',
+        "return self.handlers[method](body)",
+    )
+    root = _tree(tmp_path, {"agg.py": src})
+    findings = run_analysis(root, rules=["rpc-conformance"])
+    bypass = [
+        f for f in findings if f.check == "transport-dispatch-bypass"
+    ]
+    assert len(bypass) == 1, findings
+    assert "AggRingServer" in bypass[0].message
+
+
+def test_agg_forward_unkeyed_member_push_flagged(tmp_path):
+    # a worker push without report_key: a retry after an ambiguous
+    # failure would double-apply at the aggregator
+    src = AGG_FORWARD_GOOD.replace(
+        '{"delta": b"", "report_key": key}', '{"delta": b""}'
+    )
+    root = _tree(tmp_path, {"agg.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance"
+    )
+    assert "missing-dedup-key" in checks
+
+
+def test_agg_forward_unregistered_upstream_method(tmp_path):
+    # the forward targets a method no servicer registers — the cohort
+    # would die with UNIMPLEMENTED at the PS boundary
+    src = AGG_FORWARD_GOOD.replace(
+        'upstream.call(\n            "PSPushDeltaCombined",',
+        'upstream.call(\n            "PSPushCombined",',
+    )
+    root = _tree(tmp_path, {"agg.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["rpc-conformance"]), "rpc-conformance"
+    )
+    assert "no-handler" in checks
+
+
 # -- lock-discipline ---------------------------------------------------------
 
 LOCK_BAD = """
