@@ -323,6 +323,67 @@ def _stop_sched_job(job):
     job["server"].stop()
 
 
+def _annotate_nulls(record, reasons=None):
+    """Honest-null pass (same contract as bench.py): a null headline
+    field gets a `<field>_skipped_reason` sibling so a consumer can
+    tell 'not applicable in this mode' from 'silently lost'."""
+    reasons = reasons or {}
+    for field in [k for k, v in record.items() if v is None]:
+        record[f"{field}_skipped_reason"] = reasons.get(
+            field, "not measured in this mode"
+        )
+    return record
+
+
+def trace_main(name):
+    """`--trace <name>` / EDL_ELASTIC_BENCH_TRACE: replay one churn
+    trace (chaos/scenario.py) and print its scenario report as ONE
+    JSON line — per-job goodput + retention + relaunch/preemption
+    counters, with exact versions asserted at every probe point. The
+    runner raises (and dumps the flight recorder) on any broken
+    invariant, so reaching the JSON line IS the pass signal."""
+    from elasticdl_tpu.chaos.scenario import ScenarioRunner, load_trace
+    from elasticdl_tpu.common.constants import (
+        ENV_ELASTIC_BENCH_TRACE_SCALE,
+    )
+
+    scale = float(os.environ.get(ENV_ELASTIC_BENCH_TRACE_SCALE, "1.0"))
+    trace = load_trace(name)
+    print(
+        f"bench_elastic[trace]: {trace.name} (scale {scale:g}): "
+        f"{trace.description}",
+        file=sys.stderr,
+    )
+    report = ScenarioRunner(trace, scale=scale).run()
+    null_reasons = {
+        "retention": (
+            "trace sets baseline=false: no fault-free twin was run "
+            "to provide the denominator"
+        ),
+        "baseline_images_per_sec": (
+            "trace sets baseline=false: no fault-free twin was run"
+        ),
+    }
+    goodput_reasons = {
+        "goodput_fraction": "no completed records in the clocked window",
+        "gap_explained": (
+            "no raw-vs-goodput gap: zero records were recomputed"
+        ),
+    }
+    for job in report["jobs"].values():
+        _annotate_nulls(job["goodput"], goodput_reasons)
+        # acceptance bar: whatever gap exists must be explained by the
+        # recompute counter (identity by construction; guards against
+        # a future accounting change silently breaking it)
+        explained = job["goodput"].get("gap_explained")
+        if explained is not None:
+            assert abs(explained - 1.0) <= 0.01, (
+                f"goodput gap not explained by recomputed records: "
+                f"{explained}"
+            )
+    print(json.dumps(_annotate_nulls(report, null_reasons)))
+
+
 def sched_main():
     """The policy-plane contention bench (EDL_ELASTIC_BENCH_SCHED=1 or
     --sched): a best-effort job holds a 2-token arbiter fleet; at 25%
@@ -430,13 +491,23 @@ def sched_main():
             "clocked per job from its first completed task"
         ),
     }
-    print(json.dumps(out))
+    print(json.dumps(_annotate_nulls(out)))
 
 
 def main():
+    argv = sys.argv[1:]
+    trace = os.environ.get("EDL_ELASTIC_BENCH_TRACE", "")
+    if "--trace" in argv:
+        idx = argv.index("--trace")
+        if idx + 1 >= len(argv):
+            print("--trace needs a trace name or path", file=sys.stderr)
+            return 2
+        trace = argv[idx + 1]
+    if trace:
+        return trace_main(trace)
     if (
         os.environ.get("EDL_ELASTIC_BENCH_SCHED", "") == "1"
-        or "--sched" in sys.argv[1:]
+        or "--sched" in argv
     ):
         return sched_main()
     # auto-scale to the host: on a single-core machine the worker
@@ -568,7 +639,7 @@ def main():
     spread = max(rets) - min(rets)
     print(
         json.dumps(
-            {
+            _annotate_nulls({
                 "metric": "elastic_throughput_retention_50pct_kill",
                 "value": round(mean, 3),
                 "unit": "ratio",
@@ -617,10 +688,10 @@ def main():
                     "replacement reuses the incumbents' compiled "
                     "programs on boot"
                 ),
-            }
+            })
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -118,6 +118,18 @@ class AggGroup:
         server.start()
         return servicer, server
 
+    def pid_of(self, agg_id: int) -> Optional[int]:
+        """Live pid of a process-mode node, None otherwise (inproc,
+        dead, or not yet booted). Fault injectors (chaos/scenario.py
+        kill_host) go through this instead of reaching into _procs."""
+        i = int(agg_id)
+        if self._mode != "process" or i >= len(self._procs):
+            return None
+        p = self._procs[i]
+        if p is None or p.poll() is not None:
+            return None
+        return p.pid
+
     # -- recovery plane hooks ------------------------------------------------
 
     def poll_dead(self) -> List[tuple]:

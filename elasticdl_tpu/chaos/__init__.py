@@ -1,0 +1,18 @@
+"""Churn harness: trace-driven fault scenarios against a real fleet.
+
+`chaos/scenario.py` replays declarative traces (kill waves, graceful
+drains, flash-crowd arrivals, straggler latency plans, rolling per-host
+failures) against live multi-job ProcessBackend fleets and accounts for
+goodput — see the module docstring and docs/fault_model.md.
+"""
+
+from elasticdl_tpu.chaos.scenario import (  # noqa: F401
+    ScenarioRunner,
+    ScenarioScheduler,
+    TraceError,
+    TraceSpec,
+    compute_goodput,
+    list_traces,
+    load_trace,
+    parse_trace,
+)

@@ -1,0 +1,1106 @@
+"""Trace-driven churn scenarios: spot-market failure shapes as data.
+
+The chaos plane (rpc/chaos.py) injects single faults at the RPC layer;
+the benches hard-code one churn shape each (bench_elastic's kill
+waves, its --sched preemption). What neither covers is the thing a
+spot-market deployment actually faces: *composed* failure sequences —
+a kill wave landing during a drain, a flash crowd of job arrivals on a
+saturated host, a whole node taking an aggregator down with its
+workers. This module makes those sequences declarative:
+
+- a **trace** (JSON, see `parse_trace`) names jobs and a list of timed
+  or progress-keyed events: ``kill`` (SIGKILL a seeded-random fraction
+  of the live pool), ``drain`` (SIGTERM scale-down through the policy
+  plane — workers flush at a task boundary), ``scale_up``,
+  ``spawn_job`` (flash-crowd arrival of a deferred job), ``kill_host``
+  (an aggregator node dies WITH every worker mapped to it), and
+  ``chaos_arm``/``chaos_disarm`` (create/remove the latch file behind
+  a FaultPlan entry's ``armed_file``, switching an inherited fault
+  spec on for exactly one scenario window — e.g. drops composed into a
+  drain);
+- a **ScenarioScheduler** executes events deterministically: victim
+  picks come from `random.Random(seed)` over the sorted live pool, and
+  every decision is appended to a canonical-JSON timeline — same seed
+  + same fleet states => byte-identical timeline (tested);
+- a **ScenarioRunner** boots each job as a real master (dispatcher +
+  servicer + RpcServer + ProcessBackend + WorkerManager, RecoveryPlane
+  when the job has PS shards — the same wiring as master main), drives
+  the trace, probes exactness mid-run THROUGH GetSchedStats (the
+  ``exactness`` block: version == init_version + applied_update_steps
+  under one servicer lock), and hard-fails unless every job finishes
+  with zero dropped tasks at its exact expected version.
+
+**Goodput accounting**: raw throughput counts every completed record —
+including records that were trained, lost to a preemption, and trained
+again. The dispatcher now separates those (task_dispatcher.py):
+
+- ``requeued_records``: records put back on the todo queue by a death
+  or failure (work *at risk* of recomputation);
+- ``recomputed_records``: charged when a task finally succeeds, as
+  (prior dispatches) x (task records) — exactly the records the fleet
+  processed more than once;
+- ``drain_flushed_records``: completions reported by a worker inside
+  its policy-stop window (the graceful-drain flush). Informational:
+  flushed work is real work, counted once — it is never subtracted
+  and never double-counted into ``recomputed_records``.
+
+    goodput_ips = (completed - recomputed) / elapsed
+    raw_ips     = completed / elapsed
+
+so raw - goodput == recomputed/elapsed *identically* — the gap between
+the throughput a dashboard shows and the progress the job made is
+explained record-for-record by the recompute counter (asserted by
+`compute_goodput` consumers within float tolerance).
+
+Run a packaged trace::
+
+    python bench_elastic.py --trace preemption-storm
+    EDL_ELASTIC_BENCH_TRACE=rolling-node-failure python bench_elastic.py
+
+Reference: ElasticDL documents pod-kill drills manually
+(elasticdl/doc/elastic_scheduling.md); here the drill is a versioned
+artifact the CI replays (.github/workflows/ci.yml churn-scenario).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.common.constants import (
+    ENV_CHAOS_SPEC,
+    ENV_TRACE_PROBE_SECS,
+    ENV_TRACE_SEED,
+)
+from elasticdl_tpu.common.log_util import get_logger
+from elasticdl_tpu.obs import flight as obs_flight
+
+logger = get_logger(__name__)
+
+MODEL_DEF = "mnist_functional_api.custom_model"
+IMAGE_SHAPE = (28, 28, 1)
+DATA_SHARDS = 4
+
+ACTIONS = (
+    "kill",
+    "drain",
+    "scale_up",
+    "spawn_job",
+    "chaos_arm",
+    "chaos_disarm",
+    "kill_host",
+)
+
+_JOB_KEYS = {
+    "tag", "records", "epochs", "workers", "minibatch",
+    "records_per_task", "local_updates", "num_ps", "num_agg",
+    "speculate", "qos", "seed", "standby", "deferred", "extra_args",
+}
+_EVENT_KEYS = {
+    "at_progress", "at_records", "at_elapsed", "job", "action",
+    "fraction", "count", "latch", "host", "spawn",
+}
+_TRACE_KEYS = {
+    "name", "seed", "description", "jobs", "events", "chaos", "expect",
+    "baseline", "time_limit_secs",
+}
+_EXPECT_KEYS = {
+    "min_relaunches", "min_promotions", "min_policy_stops",
+    "min_requeued_records", "min_recomputed_records",
+    "min_drain_flushed_records", "min_preempted_task_requeues",
+    "min_scale_ups",
+}
+
+
+class TraceError(ValueError):
+    """Malformed trace: the runner refuses to guess at churn shapes."""
+
+
+@dataclass
+class JobSpec:
+    tag: str
+    records: int
+    epochs: int = 1
+    workers: int = 3
+    minibatch: int = 64
+    records_per_task: int = 128
+    local_updates: int = 2
+    num_ps: int = 0
+    num_agg: int = 0
+    speculate: bool = False
+    qos: str = ""
+    seed: int = 0
+    standby: int = 0
+    deferred: bool = False
+    extra_args: List[str] = field(default_factory=list)
+
+    @property
+    def total(self) -> int:
+        return self.records * self.epochs
+
+    @property
+    def expected_version(self) -> int:
+        return self.total // self.minibatch
+
+
+@dataclass
+class TraceEvent:
+    action: str
+    job: str
+    at_progress: Optional[float] = None
+    at_records: Optional[int] = None
+    at_elapsed: Optional[float] = None
+    fraction: float = 0.0
+    count: int = 1
+    latch: str = ""
+    host: int = -1
+    spawn: str = ""
+
+    def due(self, completed: int, total: int, elapsed: float) -> bool:
+        if self.at_elapsed is not None:
+            return elapsed >= self.at_elapsed
+        if self.at_records is not None:
+            return completed >= self.at_records
+        return total > 0 and completed / total >= self.at_progress
+
+
+@dataclass
+class TraceSpec:
+    name: str
+    seed: int
+    description: str
+    jobs: List[JobSpec]
+    events: List[TraceEvent]
+    chaos: Optional[dict]
+    latches: List[str]
+    expect: Dict[str, int]
+    baseline: bool
+    time_limit_secs: float
+
+    def job(self, tag: str) -> JobSpec:
+        for j in self.jobs:
+            if j.tag == tag:
+                return j
+        raise KeyError(tag)
+
+
+def _reject_unknown(d: dict, allowed: set, what: str) -> None:
+    unknown = sorted(set(d) - allowed)
+    if unknown:
+        raise TraceError(f"{what}: unknown keys {unknown}")
+
+
+def _parse_job(d: dict, idx: int) -> JobSpec:
+    if not isinstance(d, dict):
+        raise TraceError(f"jobs[{idx}] must be an object")
+    _reject_unknown(d, _JOB_KEYS, f"jobs[{idx}]")
+    for key in ("tag", "records"):
+        if key not in d:
+            raise TraceError(f"jobs[{idx}] missing required key {key!r}")
+    spec = JobSpec(
+        tag=str(d["tag"]),
+        records=int(d["records"]),
+        epochs=int(d.get("epochs", 1)),
+        workers=int(d.get("workers", 3)),
+        minibatch=int(d.get("minibatch", 64)),
+        records_per_task=int(d.get("records_per_task", 128)),
+        local_updates=int(d.get("local_updates", 2)),
+        num_ps=int(d.get("num_ps", 0)),
+        num_agg=int(d.get("num_agg", 0)),
+        speculate=bool(d.get("speculate", False)),
+        qos=str(d.get("qos", "")),
+        seed=int(d.get("seed", 0)),
+        standby=int(d.get("standby", 0)),
+        deferred=bool(d.get("deferred", False)),
+        extra_args=[str(a) for a in d.get("extra_args", [])],
+    )
+    if spec.workers < 1:
+        raise TraceError(f"job {spec.tag!r}: workers must be >= 1")
+    if spec.records_per_task % spec.minibatch != 0:
+        raise TraceError(
+            f"job {spec.tag!r}: records_per_task must be a multiple of "
+            "minibatch (whole windows per task)"
+        )
+    chunk = DATA_SHARDS * spec.records_per_task
+    if spec.records <= 0 or spec.records % chunk != 0:
+        raise TraceError(
+            f"job {spec.tag!r}: records must be a positive multiple of "
+            f"{chunk} ({DATA_SHARDS} shards x records_per_task)"
+        )
+    if spec.num_agg > 0 and spec.num_ps <= 0:
+        raise TraceError(f"job {spec.tag!r}: num_agg requires num_ps")
+    return spec
+
+
+def _parse_event(d: dict, idx: int, jobs: List[JobSpec],
+                 latches: List[str]) -> TraceEvent:
+    if not isinstance(d, dict):
+        raise TraceError(f"events[{idx}] must be an object")
+    _reject_unknown(d, _EVENT_KEYS, f"events[{idx}]")
+    action = d.get("action")
+    if action not in ACTIONS:
+        raise TraceError(
+            f"events[{idx}]: unknown action {action!r} "
+            f"(one of {', '.join(ACTIONS)})"
+        )
+    anchors = [k for k in ("at_progress", "at_records", "at_elapsed")
+               if k in d]
+    if len(anchors) != 1:
+        raise TraceError(
+            f"events[{idx}]: exactly one of at_progress/at_records/"
+            f"at_elapsed required, got {anchors or 'none'}"
+        )
+    tags = [j.tag for j in jobs]
+    job = str(d.get("job", tags[0]))
+    if job not in tags:
+        raise TraceError(f"events[{idx}]: unknown job {job!r}")
+    ev = TraceEvent(
+        action=action,
+        job=job,
+        at_progress=(float(d["at_progress"])
+                     if "at_progress" in d else None),
+        at_records=int(d["at_records"]) if "at_records" in d else None,
+        at_elapsed=float(d["at_elapsed"]) if "at_elapsed" in d else None,
+        fraction=float(d.get("fraction", 0.0)),
+        count=int(d.get("count", 1)),
+        latch=str(d.get("latch", "")),
+        host=int(d.get("host", -1)),
+        spawn=str(d.get("spawn", "")),
+    )
+    if ev.at_progress is not None and not 0.0 <= ev.at_progress <= 1.0:
+        raise TraceError(f"events[{idx}]: at_progress must be in [0,1]")
+    if action == "kill" and ev.fraction <= 0.0 and "count" not in d:
+        raise TraceError(
+            f"events[{idx}]: kill needs fraction>0 or an explicit count"
+        )
+    if action in ("drain", "scale_up") and ev.count < 1:
+        raise TraceError(f"events[{idx}]: {action} count must be >= 1")
+    if action == "spawn_job":
+        if ev.spawn not in tags:
+            raise TraceError(
+                f"events[{idx}]: spawn_job needs spawn=<job tag>, "
+                f"got {ev.spawn!r}"
+            )
+        if not next(j for j in jobs if j.tag == ev.spawn).deferred:
+            raise TraceError(
+                f"events[{idx}]: spawned job {ev.spawn!r} must be "
+                "declared deferred"
+            )
+    if action in ("chaos_arm", "chaos_disarm") and ev.latch not in latches:
+        raise TraceError(
+            f"events[{idx}]: latch {ev.latch!r} is not an armed_file of "
+            f"any chaos fault (declared: {latches or 'none'})"
+        )
+    if action == "kill_host":
+        target = next(j for j in jobs if j.tag == job)
+        if not 0 <= ev.host < target.num_agg:
+            raise TraceError(
+                f"events[{idx}]: kill_host host {ev.host} out of range "
+                f"for job {job!r} (num_agg={target.num_agg})"
+            )
+    return ev
+
+
+def parse_trace(raw: dict) -> TraceSpec:
+    """Strict trace validation: unknown keys, unknown actions, missing
+    anchors, dangling job/latch references all raise TraceError — a
+    typo'd trace must fail loudly, not silently skip its churn."""
+    if not isinstance(raw, dict):
+        raise TraceError("trace must be a JSON object")
+    _reject_unknown(raw, _TRACE_KEYS, "trace")
+    for key in ("name", "seed", "jobs", "events"):
+        if key not in raw:
+            raise TraceError(f"trace missing required key {key!r}")
+    jobs = [_parse_job(j, i) for i, j in enumerate(raw["jobs"] or [])]
+    if not jobs:
+        raise TraceError("trace needs at least one job")
+    tags = [j.tag for j in jobs]
+    if len(set(tags)) != len(tags):
+        raise TraceError(f"duplicate job tags: {tags}")
+    if jobs[0].deferred:
+        raise TraceError("jobs[0] is the anchor job and cannot be deferred")
+
+    chaos = raw.get("chaos")
+    latches: List[str] = []
+    if chaos is not None:
+        if not isinstance(chaos, dict):
+            raise TraceError("chaos must be an object (FaultPlan spec)")
+        from elasticdl_tpu.rpc.chaos import Fault
+
+        try:
+            faults = [Fault.from_dict(f) for f in chaos.get("faults", [])]
+        except ValueError as e:
+            raise TraceError(f"chaos spec: {e}") from e
+        for f in faults:
+            # armed_file in a TRACE is a latch NAME; the runner rewrites
+            # it to a file under the run dir (chaos_arm creates it)
+            if f.armed_file and os.path.sep in f.armed_file:
+                raise TraceError(
+                    f"chaos armed_file {f.armed_file!r} must be a bare "
+                    "latch name, not a path (the runner owns placement)"
+                )
+            if f.armed_file:
+                latches.append(f.armed_file)
+
+    events = [_parse_event(e, i, jobs, latches)
+              for i, e in enumerate(raw["events"] or [])]
+    spawned = [e.spawn for e in events if e.action == "spawn_job"]
+    for j in jobs:
+        if j.deferred and spawned.count(j.tag) != 1:
+            raise TraceError(
+                f"deferred job {j.tag!r} must be spawned by exactly one "
+                f"spawn_job event (found {spawned.count(j.tag)})"
+            )
+
+    expect = raw.get("expect") or {}
+    _reject_unknown(expect, _EXPECT_KEYS, "expect")
+    return TraceSpec(
+        name=str(raw["name"]),
+        seed=int(raw["seed"]),
+        description=str(raw.get("description", "")),
+        jobs=jobs,
+        events=events,
+        chaos=chaos,
+        latches=latches,
+        expect={k: int(v) for k, v in expect.items()},
+        baseline=bool(raw.get("baseline", False)),
+        time_limit_secs=float(raw.get("time_limit_secs", 1800.0)),
+    )
+
+
+def traces_dir() -> str:
+    return os.path.join(os.path.dirname(__file__), "traces")
+
+
+def list_traces() -> List[str]:
+    return sorted(
+        f[:-5] for f in os.listdir(traces_dir()) if f.endswith(".json")
+    )
+
+
+def load_trace(name_or_path: str) -> TraceSpec:
+    """Packaged trace by name, or any path to a trace JSON."""
+    path = name_or_path
+    if not os.path.isfile(path):
+        path = os.path.join(traces_dir(), f"{name_or_path}.json")
+        if not os.path.isfile(path):
+            raise TraceError(
+                f"unknown trace {name_or_path!r} "
+                f"(packaged: {', '.join(list_traces())})"
+            )
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except json.JSONDecodeError as e:
+        raise TraceError(f"{path}: not valid JSON: {e}") from e
+    return parse_trace(raw)
+
+
+# -- deterministic event scheduling ------------------------------------------
+
+
+class ScenarioScheduler:
+    """Seeded decision core, separated from process execution so the
+    determinism contract is testable without booting a fleet: every
+    decision (victim picks, counts, event firings) appends one
+    canonical-JSON line to `timeline`. Same seed + same observed fleet
+    states => byte-identical timeline; wall-clock never enters it."""
+
+    def __init__(self, trace: TraceSpec, seed: Optional[int] = None):
+        self.trace = trace
+        self.seed = trace.seed if seed is None else int(seed)
+        self._rng = random.Random(self.seed)
+        self.timeline: List[str] = []
+        self._pending: List[TraceEvent] = list(trace.events)
+        self._seq = 0
+
+    def record(self, action: str, job: str, **fields) -> dict:
+        entry = {"seq": self._seq, "action": action, "job": job}
+        entry.update(fields)
+        self._seq += 1
+        self.timeline.append(
+            json.dumps(entry, sort_keys=True, separators=(",", ":"))
+        )
+        return entry
+
+    def pick_victims(self, alive: List[int], count: int) -> List[int]:
+        """`count` victims from the live pool. Sorting before sampling
+        makes the pick a pure function of (seed, draw index, pool as a
+        SET) — the caller's iteration order can't perturb it."""
+        pool = sorted(alive)
+        count = min(max(0, int(count)), len(pool))
+        if count == 0:
+            return []
+        return sorted(self._rng.sample(pool, count))
+
+    def kill_count(self, alive: int, ev: TraceEvent) -> int:
+        if ev.fraction > 0.0:
+            return max(1, int(alive * ev.fraction)) if alive else 0
+        return min(ev.count, alive)
+
+    def due_events(
+        self,
+        progress: Callable[[str], int],
+        totals: Dict[str, int],
+        elapsed: float,
+    ) -> List[TraceEvent]:
+        """Pop every pending event whose anchor is satisfied, in
+        declaration order (ties break by trace order, deterministic)."""
+        due, still = [], []
+        for ev in self._pending:
+            if ev.due(progress(ev.job), totals.get(ev.job, 0), elapsed):
+                due.append(ev)
+            else:
+                still.append(ev)
+        self._pending = still
+        return due
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+
+# -- goodput arithmetic (pure; unit-tested) ----------------------------------
+
+
+def compute_goodput(counters: Dict[str, int], elapsed: float) -> dict:
+    """Turn the dispatcher's goodput counters into rates. The defining
+    identity — raw - goodput == recomputed/elapsed — holds exactly by
+    construction; `gap_explained` reports the ratio so a scenario can
+    assert its goodput/raw gap is explained by the recompute counter
+    (1.0 when there was any gap; None for a gapless fault-free run).
+
+    drain_flushed_records is deliberately NOT in the arithmetic: a
+    drain flush is real work counted once (it is also never inside
+    recomputed_records — the dispatcher credits a drain flush at
+    success and only charges recompute for PRIOR dispatches of the
+    same task)."""
+    completed = int(counters.get("completed_records", 0))
+    recomputed = int(counters.get("recomputed_records", 0))
+    if recomputed > completed:
+        raise ValueError(
+            f"recomputed_records {recomputed} > completed_records "
+            f"{completed}: counter corruption"
+        )
+    raw = completed / elapsed if elapsed > 0 else 0.0
+    good = (completed - recomputed) / elapsed if elapsed > 0 else 0.0
+    gap = raw - good
+    return {
+        "raw_images_per_sec": raw,
+        "goodput_images_per_sec": good,
+        "goodput_fraction": (good / raw) if raw > 0 else None,
+        "gap_images_per_sec": gap,
+        "gap_from_recompute_images_per_sec": (
+            recomputed / elapsed if elapsed > 0 else 0.0
+        ),
+        "gap_explained": (recomputed / elapsed) / gap if gap > 0 else None,
+        "completed_records": completed,
+        "requeued_records": int(counters.get("requeued_records", 0)),
+        "recomputed_records": recomputed,
+        "drain_flushed_records": int(
+            counters.get("drain_flushed_records", 0)
+        ),
+        "preempted_task_requeues": int(
+            counters.get("preempted_task_requeues", 0)
+        ),
+    }
+
+
+# -- job lifecycle -----------------------------------------------------------
+
+
+class JobRun:
+    """One trace job booted as a real master + ProcessBackend fleet —
+    the same wiring as master main: RecoveryPlane when the job has PS
+    shards, standby sample-batch service when it has standbys, the
+    dispatcher's draining hook pointed at the manager's policy-stop
+    set, and the goodput counters surfaced through GetSchedStats."""
+
+    def __init__(self, spec: JobSpec, run_dir: str, cache_dir: str,
+                 worker_env: Dict[str, str]):
+        self.spec = spec
+        self.t0: Optional[float] = None
+        self.t_end: Optional[float] = None
+        self.probes = 0
+        self.ps_dead = False
+        self._run_dir = run_dir
+        self._cache_dir = cache_dir
+        self._worker_env = dict(worker_env)
+        self._recovery = None
+
+    def start(self) -> None:
+        from elasticdl_tpu.cluster.pod_backend import ProcessBackend
+        from elasticdl_tpu.common.args import (
+            master_parser,
+            resolve_compile_cache_envs,
+            worker_forward_args,
+        )
+        from elasticdl_tpu.master.main import (
+            build_master,
+            make_sample_batch_fn,
+        )
+        from elasticdl_tpu.master.worker_manager import WorkerManager
+        from elasticdl_tpu.models.record_codec import (
+            write_synthetic_image_records,
+        )
+        from elasticdl_tpu.rpc.server import RpcServer
+
+        spec = self.spec
+        data_dir = os.path.join(self._run_dir, f"data-{spec.tag}")
+        os.makedirs(data_dir, exist_ok=True)
+        per_shard = spec.records // DATA_SHARDS
+        for i in range(DATA_SHARDS):
+            write_synthetic_image_records(
+                os.path.join(data_dir, f"shard-{i}.rio"),
+                per_shard,
+                IMAGE_SHAPE,
+                10,
+                seed=spec.seed * DATA_SHARDS + i,
+            )
+        argv = [
+            "--model_zoo",
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)), "models"
+            ),
+            "--model_def", MODEL_DEF,
+            "--minibatch_size", str(spec.minibatch),
+            "--training_data_dir", data_dir,
+            "--records_per_task", str(spec.records_per_task),
+            "--num_epochs", str(spec.epochs),
+            "--grads_to_wait", "1",
+            "--local_updates", str(spec.local_updates),
+            "--num_workers", str(spec.workers),
+            "--worker_backend", "process",
+            "--compile_cache_dir", self._cache_dir,
+        ]
+        if spec.num_ps:
+            argv += ["--num_ps", str(spec.num_ps)]
+        if spec.num_agg:
+            argv += ["--num_agg", str(spec.num_agg)]
+        if spec.speculate:
+            argv += ["--speculate"]
+        if spec.qos:
+            argv += ["--qos_class", spec.qos]
+        argv += spec.extra_args
+        args = master_parser().parse_args(argv)
+        _spec, self.dispatcher, self.servicer, _, _ = build_master(
+            args, "training"
+        )
+        self.server = RpcServer(self.servicer.handlers(), port=0)
+        self.server.start()
+        self.backend = ProcessBackend(
+            log_dir=os.path.join(self._run_dir, f"logs-{spec.tag}")
+        )
+        addr = f"localhost:{self.server.port}"
+        self.manager = WorkerManager(
+            self.backend,
+            self.dispatcher,
+            num_workers=spec.workers,
+            worker_argv_fn=lambda wid: worker_forward_args(
+                args, wid, addr
+            ),
+            envs={
+                "JAX_PLATFORMS": "cpu",
+                **resolve_compile_cache_envs(args),
+                **self._worker_env,
+            },
+            max_relaunches=4 * spec.workers,
+            num_standby=spec.standby,
+        )
+        # master-main wiring, reproduced: drain attribution + goodput
+        # on the GetSchedStats surface + standby service + recovery
+        self.dispatcher.set_draining_fn(self.manager.is_policy_stopped)
+        dispatcher, manager = self.dispatcher, self.manager
+
+        def _stats() -> dict:
+            out = {"workers": manager.snapshot()}
+            out.update(dispatcher.sched_stats())
+            out["goodput"] = dispatcher.goodput_stats()
+            return out
+
+        self.servicer.set_sched_stats_fn(_stats)
+        if spec.standby:
+            self.servicer.set_standby_fn(self.manager.is_standby)
+            self.servicer.set_sample_batch_fn(
+                make_sample_batch_fn(data_dir)
+            )
+        if (self.servicer.ps_group is not None
+                or self.servicer.kv_group is not None):
+            from elasticdl_tpu.master.recovery import RecoveryPlane
+
+            def _unrecoverable(kind, sid):
+                self.ps_dead = True
+
+            self._recovery = RecoveryPlane(
+                self.servicer,
+                ps_group=self.servicer.ps_group,
+                kv_group=self.servicer.kv_group,
+                agg_group=self.servicer.agg_group,
+                on_unrecoverable=_unrecoverable,
+            )
+            self.servicer.set_recovery_plane(self._recovery)
+            self._recovery.start()
+            self.manager.on_shard_failure = self._recovery.on_shard_failure
+        self.manager.start_workers()
+        logger.info(
+            "scenario job %s: %d workers on %s (total %d records)",
+            spec.tag, spec.workers, addr, spec.total,
+        )
+
+    # -- fleet views used by the scheduler --------------------------------
+
+    def alive_workers(self) -> List[int]:
+        """Live, active, pid-backed workers — the kill-eligible pool
+        (same definition as bench_elastic's kill waves: a pid-less
+        victim would silently shrink the killed fraction)."""
+        from elasticdl_tpu.cluster.pod_backend import PodPhase
+
+        return [
+            wid
+            for wid, ph in self.manager.phases().items()
+            if ph in (PodPhase.PENDING, PodPhase.RUNNING)
+            and not self.manager.is_standby(wid)
+            and not self.manager.is_policy_stopped(wid)
+            and self.backend.pid_of(wid)
+        ]
+
+    def sigkill_workers(self, victims: List[int]) -> int:
+        n = 0
+        for wid in victims:
+            pid = self.backend.pid_of(wid)
+            if not pid:
+                continue
+            try:
+                os.kill(pid, signal.SIGKILL)
+                n += 1
+            except ProcessLookupError:
+                pass  # died on its own between pid_of and the kill
+        return n
+
+    def kill_host(self, host: int) -> dict:
+        """A node dies: aggregator `host` AND every live worker mapped
+        to it (worker->agg mapping is wid % num_agg, worker/worker.py)
+        go down together, SIGKILL. The RecoveryPlane relaunches the
+        aggregator (stateless, fresh generation); the WorkerManager
+        relaunches the workers."""
+        agg = self.servicer.agg_group
+        workers = [
+            wid for wid in self.alive_workers()
+            if wid % self.spec.num_agg == host
+        ]
+        killed = self.sigkill_workers(workers)
+        agg_pid = agg.pid_of(host) if agg is not None else None
+        if agg_pid:
+            try:
+                os.kill(agg_pid, signal.SIGKILL)
+            except ProcessLookupError:
+                agg_pid = None
+        return {
+            "host": host,
+            "workers": workers,
+            "workers_killed": killed,
+            "agg_killed": bool(agg_pid),
+        }
+
+    def exactness_probe(self) -> dict:
+        """One GetSchedStats round — the REAL stats code path, not a
+        private-field peek — asserting the master-version invariant.
+        PS-sharded jobs carry their versions on the shards; those are
+        asserted exactly at completion (a mid-restore assemble is not
+        a stable read), so here their master invariant is the trivial
+        one (version==init, applied==0) and still must hold."""
+        st = self.servicer.get_sched_stats({})
+        ex = st["exactness"]
+        assert ex["version"] == (
+            ex["init_version"] + ex["applied_update_steps"]
+        ), (
+            f"job {self.spec.tag}: version {ex['version']} != init "
+            f"{ex['init_version']} + applied {ex['applied_update_steps']}"
+            " — an update advanced the model without being counted"
+        )
+        self.probes += 1
+        return st
+
+    def finish_checks(self) -> dict:
+        """Exactness at completion: zero dropped tasks, every record
+        exactly once, version == applied pushes exactly."""
+        spec = self.spec
+        assert not self.dispatcher.has_failed_tasks(), (
+            f"job {spec.tag}: dropped tasks"
+        )
+        done = self.dispatcher.completed_records()
+        assert done == spec.total, (
+            f"job {spec.tag}: completed {done} != total {spec.total}"
+        )
+        st = self.exactness_probe()
+        versions: List[int] = []
+        if self.servicer.ps_group is not None:
+            versions, _ = self.servicer.ps_group.assemble()
+            assert list(versions) == (
+                [spec.expected_version] * spec.num_ps
+            ), (
+                f"job {spec.tag}: shard versions {list(versions)} != "
+                f"{[spec.expected_version] * spec.num_ps}"
+            )
+        else:
+            v = self.servicer.version
+            assert v == spec.expected_version, (
+                f"job {spec.tag}: version {v} != expected "
+                f"{spec.expected_version} "
+                f"({spec.total} records / {spec.minibatch} minibatch)"
+            )
+            versions = [v]
+        return {"stats": st, "versions": list(versions)}
+
+    def stop(self) -> None:
+        if self._recovery is not None:
+            self._recovery.stop()
+        self.manager.stop_relaunch_and_remove_workers()
+        self.backend.stop()
+        # shard tiers in main.py's teardown order (agg, ps, kv),
+        # best-effort each: a failed scenario must not leak orphan
+        # shard processes holding the parent's stdio pipes open
+        for group in (
+            self.servicer.agg_group,
+            self.servicer.ps_group,
+            self.servicer.kv_group,
+        ):
+            if group is not None:
+                try:
+                    group.stop()
+                except Exception:
+                    logger.warning(
+                        "scenario job %s: shard group stop failed",
+                        self.spec.tag,
+                        exc_info=True,
+                    )
+        self.server.stop()
+
+
+# -- the runner --------------------------------------------------------------
+
+
+class ScenarioRunner:
+    """Executes one TraceSpec against a live fleet and returns the
+    scenario report (one JSON-able dict). Raises on any broken
+    invariant — after dumping the flight recorder for the postmortem."""
+
+    def __init__(
+        self,
+        trace: TraceSpec,
+        *,
+        scale: float = 1.0,
+        seed: Optional[int] = None,
+        probe_secs: Optional[float] = None,
+        run_dir: Optional[str] = None,
+    ):
+        self.trace = trace
+        self.scale = float(scale)
+        env_seed = os.environ.get(ENV_TRACE_SEED, "").strip()
+        self.sched = ScenarioScheduler(
+            trace,
+            seed=(seed if seed is not None
+                  else int(env_seed) if env_seed else None),
+        )
+        self.probe_secs = (
+            probe_secs
+            if probe_secs is not None
+            else float(os.environ.get(ENV_TRACE_PROBE_SECS, "0.5"))
+        )
+        self.run_dir = run_dir or tempfile.mkdtemp(
+            prefix=f"edl_scenario_{trace.name}_"
+        )
+        self._jobs: Dict[str, JobRun] = {}
+
+    # records are scaled in whole task-chunks so every sizing invariant
+    # (whole windows per task, whole tasks per shard) survives the CI
+    # shrink knob
+    def _scaled(self, spec: JobSpec) -> JobSpec:
+        if self.scale == 1.0:
+            return spec
+        chunk = DATA_SHARDS * spec.records_per_task
+        records = max(chunk, round(spec.records * self.scale / chunk) * chunk)
+        out = JobSpec(**{**spec.__dict__, "records": records})
+        return out
+
+    def _latch_path(self, name: str) -> str:
+        return os.path.join(self.run_dir, "latches", f"{name}.armed")
+
+    def _chaos_env(self) -> Dict[str, str]:
+        """Rewrite latch names to run-dir paths and point the workers'
+        inherited EDL_CHAOS_SPEC at the rewritten spec file. Worker-env
+        only: the master process and PS/KV/agg shard spawns don't get
+        the spec unless a fault's role scoping asks for them — which
+        role-scoped entries do via the workers carrying the faults on
+        their CLIENT side of every plane."""
+        if self.trace.chaos is None:
+            return {}
+        os.makedirs(os.path.join(self.run_dir, "latches"), exist_ok=True)
+        spec = json.loads(json.dumps(self.trace.chaos))  # deep copy
+        for f in spec.get("faults", []):
+            if f.get("armed_file"):
+                f["armed_file"] = self._latch_path(f["armed_file"])
+        path = os.path.join(self.run_dir, "chaos_spec.json")
+        with open(path, "w") as fh:
+            json.dump(spec, fh)
+        return {ENV_CHAOS_SPEC: f"@{path}"}
+
+    def _boot(self, spec: JobSpec, worker_env: Dict[str, str]) -> JobRun:
+        run = JobRun(
+            self._scaled(spec),
+            self.run_dir,
+            os.path.join(self.run_dir, "xla-cache"),
+            worker_env,
+        )
+        run.start()
+        return run
+
+    def _execute(self, ev: TraceEvent) -> None:
+        sched, job = self.sched, self._jobs.get(ev.job)
+        if job is None and ev.action in ("kill", "drain", "scale_up",
+                                         "kill_host"):
+            raise RuntimeError(
+                f"trace event {ev.action} anchored to job {ev.job!r} "
+                "which was never spawned"
+            )
+        if ev.action == "kill":
+            alive = job.alive_workers()
+            count = sched.kill_count(len(alive), ev)
+            victims = sched.pick_victims(alive, count)
+            killed = job.sigkill_workers(victims)
+            sched.record(
+                "kill", ev.job, victims=victims, killed=killed,
+                alive=len(alive),
+            )
+        elif ev.action == "drain":
+            stopped = job.manager.scale_down(ev.count)
+            sched.record("drain", ev.job, count=ev.count, stopped=stopped)
+        elif ev.action == "scale_up":
+            started = job.manager.scale_up(ev.count)
+            sched.record("scale_up", ev.job, started=started)
+        elif ev.action == "spawn_job":
+            spec = self.trace.job(ev.spawn)
+            self._jobs[ev.spawn] = self._boot(spec, self._worker_env)
+            sched.record("spawn_job", ev.job, spawn=ev.spawn)
+        elif ev.action == "chaos_arm":
+            path = self._latch_path(ev.latch)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w"):
+                pass
+            sched.record("chaos_arm", ev.job, latch=ev.latch)
+        elif ev.action == "chaos_disarm":
+            try:
+                os.unlink(self._latch_path(ev.latch))
+            except FileNotFoundError:
+                pass
+            sched.record("chaos_disarm", ev.job, latch=ev.latch)
+        elif ev.action == "kill_host":
+            result = job.kill_host(ev.host)
+            sched.record("kill_host", ev.job, **result)
+        logger.info("scenario %s: fired %s", self.trace.name,
+                    sched.timeline[-1])
+
+    def _run_baseline(self) -> Optional[float]:
+        """Fault-free twin of the anchor job (same data seed, same
+        sizing, no events, no chaos): the denominator for retention.
+        Sequential on purpose — running it beside the churn fleet
+        would contaminate both measurements with CPU contention."""
+        if not self.trace.baseline:
+            return None
+        spec = self.trace.jobs[0]
+        base = JobRun(
+            self._scaled(
+                JobSpec(**{**spec.__dict__, "tag": f"{spec.tag}-baseline"})
+            ),
+            self.run_dir,
+            os.path.join(self.run_dir, "xla-cache"),
+            {},
+        )
+        base.start()
+        try:
+            deadline = time.time() + self.trace.time_limit_secs
+            while not base.dispatcher.finished():
+                if time.time() > deadline:
+                    raise RuntimeError("baseline run timed out")
+                if base.manager.all_exited():
+                    raise RuntimeError("baseline: all workers exited")
+                done = base.dispatcher.completed_records()
+                if base.t0 is None and done > 0:
+                    base.t0 = time.time()
+                time.sleep(0.05)
+            base.t_end = time.time()
+            base.finish_checks()
+            return base.dispatcher.completed_records() / (
+                base.t_end - base.t0
+            )
+        finally:
+            base.stop()
+
+    def run(self) -> dict:
+        trace = self.trace
+        try:
+            baseline_ips = self._run_baseline()
+            self._worker_env = self._chaos_env()
+            for spec in trace.jobs:
+                if not spec.deferred:
+                    self._jobs[spec.tag] = self._boot(
+                        spec, self._worker_env
+                    )
+            report = self._drive(baseline_ips)
+        except (AssertionError, RuntimeError) as e:
+            # the postmortem: the in-memory flight ring (chaos fires,
+            # generation bumps, scenario events) dumped to EDL_FLIGHT_DIR
+            obs_flight.record(
+                "scenario_failed", trace=trace.name, error=str(e)
+            )
+            path = obs_flight.dump_on_crash(reason="scenario_assert")
+            print(
+                f"chaos.scenario: {trace.name} FAILED: {e}\n"
+                f"chaos.scenario: flight recorder dump: {path}",
+                file=sys.stderr,
+            )
+            raise
+        finally:
+            for run in self._jobs.values():
+                run.stop()
+        return report
+
+    def _drive(self, baseline_ips: Optional[float]) -> dict:
+        trace, sched = self.trace, self.sched
+        t_start = time.time()
+        deadline = t_start + trace.time_limit_secs
+        next_probe = t_start
+
+        def progress(tag: str) -> int:
+            run = self._jobs.get(tag)
+            return run.dispatcher.completed_records() if run else 0
+
+        def totals() -> Dict[str, int]:
+            return {t: r.spec.total for t, r in self._jobs.items()}
+
+        while True:
+            now = time.time()
+            if now > deadline:
+                raise RuntimeError(
+                    f"scenario {trace.name} exceeded its "
+                    f"{trace.time_limit_secs:.0f}s time limit"
+                )
+            running = False
+            for run in self._jobs.values():
+                if run.ps_dead:
+                    raise RuntimeError(
+                        f"job {run.spec.tag}: unrecoverable PS/KV shard"
+                    )
+                done = run.dispatcher.completed_records()
+                if run.t0 is None and done > 0:
+                    run.t0 = now
+                if run.dispatcher.finished():
+                    if run.t_end is None:
+                        run.t_end = now
+                else:
+                    running = True
+                    if run.manager.all_exited():
+                        raise RuntimeError(
+                            f"job {run.spec.tag}: all workers exited "
+                            "with tasks outstanding"
+                        )
+            for ev in sched.due_events(
+                progress, totals(), now - t_start
+            ):
+                self._execute(ev)
+            if now >= next_probe:
+                for run in self._jobs.values():
+                    if run.t_end is None:
+                        run.exactness_probe()
+                next_probe = now + self.probe_secs
+            if not running:
+                # leftover events fall through to the assert below: a
+                # trace whose churn never fired proved nothing
+                break
+            time.sleep(0.05)
+
+        assert sched.pending() == 0, (
+            f"{sched.pending()} trace events never fired — the run "
+            "finished before their anchors; size the trace down"
+        )
+        jobs_out: Dict[str, dict] = {}
+        agg_expect: Dict[str, int] = {k: 0 for k in _EXPECT_KEYS}
+        for tag, run in self._jobs.items():
+            final = run.finish_checks()
+            elapsed = (run.t_end - run.t0) if run.t0 else 0.0
+            counters = run.dispatcher.goodput_stats()
+            goodput = compute_goodput(counters, elapsed)
+            snap = run.manager.snapshot()
+            sched_stats = run.dispatcher.sched_stats()
+            jobs_out[tag] = {
+                "total_records": run.spec.total,
+                "elapsed_secs": round(elapsed, 3),
+                "goodput": {
+                    k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in goodput.items()
+                },
+                "relaunches": snap["relaunches"],
+                "promotions": snap["promotions"],
+                "policy_stops": snap["policy_stops"],
+                "scale_ups": snap["scale_ups"],
+                "scale_downs": snap["scale_downs"],
+                "backups_dispatched": sched_stats.get(
+                    "backups_dispatched", 0
+                ),
+                "backup_wins": sched_stats.get("backup_wins", 0),
+                "versions": final["versions"],
+                "expected_version": run.spec.expected_version,
+                "exactness_probes": run.probes,
+            }
+            agg_expect["min_relaunches"] += snap["relaunches"]
+            agg_expect["min_promotions"] += snap["promotions"]
+            agg_expect["min_policy_stops"] += snap["policy_stops"]
+            agg_expect["min_scale_ups"] += snap["scale_ups"]
+            agg_expect["min_requeued_records"] += counters[
+                "requeued_records"
+            ]
+            agg_expect["min_recomputed_records"] += counters[
+                "recomputed_records"
+            ]
+            agg_expect["min_drain_flushed_records"] += counters[
+                "drain_flushed_records"
+            ]
+            agg_expect["min_preempted_task_requeues"] += counters[
+                "preempted_task_requeues"
+            ]
+        for key, floor in trace.expect.items():
+            assert agg_expect[key] >= floor, (
+                f"expect.{key}: observed {agg_expect[key]} < {floor} — "
+                "the scenario did not exercise what it claims to"
+            )
+        anchor = jobs_out[trace.jobs[0].tag]
+        retention = (
+            round(
+                anchor["goodput"]["raw_images_per_sec"] / baseline_ips, 3
+            )
+            if baseline_ips
+            else None
+        )
+        return {
+            "metric": "churn_scenario",
+            "trace": trace.name,
+            "description": trace.description,
+            "seed": sched.seed,
+            "scale": self.scale,
+            "retention": retention,
+            "baseline_images_per_sec": (
+                round(baseline_ips, 1) if baseline_ips else None
+            ),
+            "jobs": jobs_out,
+            "events": [json.loads(line) for line in sched.timeline],
+        }
+
+
+def run_scenario(name_or_path: str, **kwargs) -> dict:
+    return ScenarioRunner(load_trace(name_or_path), **kwargs).run()

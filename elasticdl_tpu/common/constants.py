@@ -108,6 +108,10 @@ ENV_TRACE_SAMPLE = "EDL_TRACE_SAMPLE"
 ENV_METRICS_PORT = "EDL_METRICS_PORT"
 ENV_FLIGHT_RECORDER_EVENTS = "EDL_FLIGHT_RECORDER_EVENTS"
 ENV_FLIGHT_DIR = "EDL_FLIGHT_DIR"
+ENV_TRACE_SEED = "EDL_TRACE_SEED"
+ENV_TRACE_PROBE_SECS = "EDL_TRACE_PROBE_SECS"
+ENV_ELASTIC_BENCH_TRACE = "EDL_ELASTIC_BENCH_TRACE"
+ENV_ELASTIC_BENCH_TRACE_SCALE = "EDL_ELASTIC_BENCH_TRACE_SCALE"
 ENV_K8S_TESTS = "K8S_TESTS"
 ENV_K8S_TEST_IMAGE = "K8S_TEST_IMAGE"
 ENV_K8S_TEST_NAMESPACE = "K8S_TEST_NAMESPACE"
@@ -332,6 +336,25 @@ ENV_REGISTRY = {
         "obs plane: directory for flight-recorder crash dumps "
         "(edl_flight_<pid>.json); default <tmpdir>/edl-flight — never "
         "the working directory (obs/flight.py)"
+    ),
+    ENV_TRACE_SEED: (
+        "churn harness: seed override for the scenario scheduler's "
+        "victim picks (chaos/scenario.py; default = the trace file's "
+        "seed field — same seed, same fleet => byte-identical timeline)"
+    ),
+    ENV_TRACE_PROBE_SECS: (
+        "churn harness: seconds between mid-run exactness probes "
+        "against GetSchedStats (chaos/scenario.py; default 0.5)"
+    ),
+    ENV_ELASTIC_BENCH_TRACE: (
+        "bench_elastic.py: run the named churn trace (packaged name "
+        "like preemption-storm, or a /path/to/trace.json) instead of "
+        "the kill-wave benchmark; same as --trace"
+    ),
+    ENV_ELASTIC_BENCH_TRACE_SCALE: (
+        "bench_elastic.py --trace: float multiplier on every job's "
+        "record count (default 1.0; CI uses <1 for short runs — "
+        "reported so shrunken runs are not mistaken for full ones)"
     ),
     ENV_K8S_TESTS: "1 enables live-cluster tests (tests/test_cluster_gated.py)",
     ENV_K8S_TEST_IMAGE: "worker image for the live-cluster tests",

@@ -568,9 +568,16 @@ def main(argv=None) -> int:
         if autoscaler is not None:
             out["autoscaler"] = autoscaler.stats()
         out["phases"] = aggregator.snapshot()
+        # goodput accounting (completed/requeued/recomputed/
+        # drain-flushed records) rides the same stats surface the
+        # churn harness and operators already poll
+        out["goodput"] = dispatcher.goodput_stats()
         return out
 
     servicer.set_sched_stats_fn(_sched_stats)
+    # drain attribution: task completions reported by a worker that a
+    # scale-down / QoS preemption is draining count as drain flushes
+    dispatcher.set_draining_fn(manager.is_policy_stopped)
     # -- observability plane (elasticdl_tpu/obs/) ------------------------
     # crash flight recorder: an uncaught master exception dumps the
     # structured event ring (fences, chaos faults, recoveries,

@@ -153,6 +153,15 @@ class MasterServicer:
         self._seen_local_updates: "OrderedDict[str, bool]" = OrderedDict()
         self._local_update_dedup_cap = 1024
         self._duplicate_local_updates = 0
+        # exactness evidence (chaos/scenario.py probes): optimizer
+        # steps actually APPLIED to this master's model. The invariant
+        # `version == init_version + applied_update_steps` holds at
+        # any instant under self._lock; a duplicate absorbed by the
+        # dedup ring advances neither. The probe asserts the invariant
+        # continuously and the exact fault-free version at job end —
+        # together they pin "every update applied exactly once".
+        self._init_version = init_version
+        self._applied_update_steps = 0
 
     # -- handler table (the 6 reference RPCs + embedding plane) -------------
 
@@ -260,6 +269,15 @@ class MasterServicer:
         out["admission"] = adm() if adm is not None else None
         with self._lock:
             out["duplicate_local_updates"] = self._duplicate_local_updates
+            # one lock acquisition = a mutually consistent exactness
+            # snapshot: the scenario probes (chaos/scenario.py) assert
+            # version == init + applied_update_steps at every poll
+            out["exactness"] = {
+                "version": self._version,
+                "init_version": self._init_version,
+                "applied_update_steps": self._applied_update_steps,
+                "duplicate_local_updates": self._duplicate_local_updates,
+            }
         return out
 
     # -- model state --------------------------------------------------------
@@ -629,6 +647,7 @@ class MasterServicer:
             if aux_state is not None:
                 self._aux = aux_state
             self._version += steps
+            self._applied_update_steps += steps
             applied_version = self._version
             if self._checkpoint_service and self._checkpoint_service.crossed(
                 prev_version, self._version
@@ -794,6 +813,11 @@ class MasterServicer:
             advanced = version > prev
             if advanced:
                 self._version = version
+                # the mirror advance IS applied update steps — they ran
+                # on the shards, not here — so count them or the
+                # exactness invariant (version == init + applied,
+                # get_sched_stats) breaks in sharded-PS mode
+                self._applied_update_steps += version - prev
             if versions:
                 # per-shard max mirror: the recovery plane's restore
                 # fence (shard_version_floor)
@@ -946,6 +970,7 @@ class MasterServicer:
                 )
             self._params = self._opt.step(self._params, dense_grads)
         self._version += 1
+        self._applied_update_steps += 1
 
     def set_train_loss_hook(self, hook):
         """hook(version, loss) — fed from worker-reported minibatch/

@@ -81,6 +81,29 @@ class TaskDispatcher:
         # cumulative records successfully trained (across epochs) —
         # progress/throughput introspection for benches and logs
         self._completed_records = 0
+        # -- goodput accounting (chaos/scenario.py, bench_elastic) ----
+        # Goodput = useful records/sec after subtracting recomputation:
+        # a task requeued by a death/failure is RE-trained from scratch,
+        # so every prior dispatch of a task that eventually completes is
+        # waste the raw throughput number silently absorbs. Dispatches
+        # are counted per task; on success the (n_dispatches - 1) prior
+        # attempts charge (end - start) records each to the recomputed
+        # counter. Speculative-backup twins are deliberately NOT counted
+        # here (they never ride the todo queue; the backup_* counters
+        # already price that waste separately). Drain-flushed records —
+        # tasks a SIGTERM'd worker finished before exiting — complete
+        # exactly once, so they add to completed_records and the drain
+        # counter but never to recomputed: no double-count.
+        self._dispatch_counts: Dict[int, int] = {}
+        self._requeued_records = 0
+        self._recomputed_records = 0
+        self._drain_flushed_records = 0
+        self._preempted_task_requeues = 0
+        # fn(worker_id) -> bool: the worker is mid graceful drain
+        # (policy stop / SIGTERM); wired to
+        # WorkerManager.is_policy_stopped by master main / the
+        # scenario runner. Never called under the manager's lock.
+        self._draining_fn: Optional[Callable[[int], bool]] = None
         # -- speculative straggler backups (elasticdl_tpu/sched/) -----
         # When a doing-task's runtime exceeds spec_factor x the
         # spec_percentile of completed same-type runtimes, an idle
@@ -179,15 +202,24 @@ class TaskDispatcher:
                 # idle worker + empty queue: maybe clone a straggler
                 return self._pick_backup_locked(worker_id)
             task = self._todo.pop(0)
-            # fresh attempt key per dispatch (requeues included): the
-            # worker derives window report_keys from it, so only a
-            # PRIMARY/BACKUP PAIR shares keys — a legitimately
-            # re-executed task never collides with its past self
-            self._attempt_seq += 1
-            task.spec_key = f"t{task.task_id}.a{self._attempt_seq}"
+            # attempt key fixed at FIRST dispatch and kept across
+            # failure requeues: the worker derives window report_keys
+            # from it, so a retrained task re-pushing a window its dead
+            # predecessor already landed is absorbed by dedup — the
+            # speculation twin rule (first-report-wins) generalized to
+            # requeues. Without this, a kill between a window push and
+            # the task report inflates the final version past the
+            # fault-free count. Epoch re-creations mint new task_ids,
+            # so keys never straddle epochs.
+            if not task.spec_key:
+                self._attempt_seq += 1
+                task.spec_key = f"t{task.task_id}.a{self._attempt_seq}"
             task.backup = False
             self._doing[task.task_id] = (worker_id, task)
             self._started[task.task_id] = self._clock()
+            self._dispatch_counts[task.task_id] = (
+                self._dispatch_counts.get(task.task_id, 0) + 1
+            )
             return task
 
     def _pick_backup_locked(self, worker_id: int) -> Optional[Task]:  # edl-lint: disable=lock-discipline -- caller holds self._lock
@@ -246,6 +278,16 @@ class TaskDispatcher:
         the task, after which another worker claimed the requeued
         shard) must not pop the new owner's entry."""
         evaluation_task_completed = None
+        # probed BEFORE taking our lock: the draining fn reaches into
+        # the WorkerManager's lock, and nesting it under self._lock
+        # would create a cross-module lock order for no benefit (a
+        # drain latch cannot flip mid-report — the worker only exits
+        # after this report returns)
+        draining = (
+            self._draining_fn is not None
+            and worker_id is not None
+            and self._draining_fn(worker_id)
+        )
         with self._lock:
             worker_and_task = self._doing.get(task_id)
             if worker_and_task is None:
@@ -300,6 +342,18 @@ class TaskDispatcher:
                     self._primary_wins += 1
             if success and task.type == TaskType.TRAINING:
                 self._completed_records += task.end - task.start
+            if success:
+                # goodput: every dispatch before the winning one was a
+                # full re-train of this shard (requeued-and-retrained);
+                # a first-dispatch success charges nothing
+                prior = self._dispatch_counts.pop(task_id, 1) - 1
+                if prior > 0 and task.type == TaskType.TRAINING:
+                    self._recomputed_records += prior * (task.end - task.start)
+                if draining and task.type == TaskType.TRAINING:
+                    # flushed by a graceful drain: counted ONCE (it is
+                    # already in completed_records); surfaced so the
+                    # drain's overhead is attributable, never subtracted
+                    self._drain_flushed_records += task.end - task.start
             if not success:
                 n = self._retry_count.get(task_id, 0) + 1
                 self._retry_count[task_id] = n
@@ -310,6 +364,7 @@ class TaskDispatcher:
                         n,
                     )
                     self.failed_tasks.append(task)
+                    self._dispatch_counts.pop(task_id, None)
                     # a dropped EVALUATION task still counts toward the
                     # eval job's completion, else has_pending() wedges
                     # every worker in WAIT forever
@@ -320,6 +375,8 @@ class TaskDispatcher:
                         evaluation_task_completed = task
                 else:
                     logger.warning("Task %d failed, requeueing", task_id)
+                    if task.type == TaskType.TRAINING:
+                        self._requeued_records += task.end - task.start
                     self._todo.append(task)
             elif (
                 task.type == TaskType.EVALUATION
@@ -334,6 +391,29 @@ class TaskDispatcher:
         """Cumulative records successfully trained (across epochs)."""
         with self._lock:
             return self._completed_records
+
+    def set_draining_fn(self, fn: Callable[[int], bool]):
+        """fn(worker_id) -> True while the worker is mid graceful drain
+        (wired to WorkerManager.is_policy_stopped); lets report()
+        attribute drain-flushed completions."""
+        self._draining_fn = fn
+
+    def goodput_stats(self) -> dict:
+        """Goodput accounting counters, one lock acquisition (a
+        mutually consistent snapshot for the exactness probes):
+        goodput subtracts `recomputed_records` from
+        `completed_records`; `requeued_records` is the work currently
+        owed to re-training (it becomes recomputed when the requeued
+        task completes); `drain_flushed_records` is informational —
+        that work completed exactly once."""
+        with self._lock:
+            return {
+                "completed_records": self._completed_records,
+                "requeued_records": self._requeued_records,
+                "recomputed_records": self._recomputed_records,
+                "drain_flushed_records": self._drain_flushed_records,
+                "preempted_task_requeues": self._preempted_task_requeues,
+            }
 
     def recover_tasks(self, worker_id: int):
         """Requeue every in-flight task of a dead worker
@@ -370,6 +450,9 @@ class TaskDispatcher:
                 _, task = self._doing.pop(tid)
                 self._started.pop(tid, None)
                 logger.info("Recovering task %d from dead worker %d", tid, worker_id)
+                if task.type == TaskType.TRAINING:
+                    self._requeued_records += task.end - task.start
+                self._preempted_task_requeues += 1
                 self._todo.append(task)
 
     def finished(self) -> bool:

@@ -118,6 +118,14 @@ class WorkerManager:
         with self._lock:
             return worker_id in self._standby
 
+    def is_policy_stopped(self, worker_id: int) -> bool:
+        """Dispatcher hook (TaskDispatcher.set_draining_fn): True from
+        the moment a scale-down / QoS preemption marks the worker until
+        its terminal event lands — exactly the window in which its task
+        reports are drain flushes, not ordinary completions."""
+        with self._lock:
+            return worker_id in self._policy_stopped
+
     def stop_relaunch_and_remove_workers(self):
         """reference: k8s_worker_manager.py:100-104."""
         with self._lock:

@@ -23,7 +23,11 @@ A `FaultPlan` is a seeded list of fault entries:
   doesn't crash again.
 
 Entries select traffic by method name, side (client/server), process
-role and target id. Role/target scoping exists because the spec
+role and target id — and, with ``armed_file``, by a cross-process
+arming window: the entry fires only while that latch file exists, so a
+spec inherited at process boot can be switched on for exactly one
+scenario window (e.g. drops composed into a graceful drain — see
+chaos/scenario.py). Role/target scoping exists because the spec
 travels by environment variable: `EDL_CHAOS_SPEC` (inline JSON or
 ``@/path/to/file.json``) is inherited by every subprocess the cluster
 spawns — PS/KV shard processes, ProcessBackend workers — and each of
@@ -107,6 +111,14 @@ class Fault:
     code: str = "UNAVAILABLE"
     when: str = "before"  # crash: before | after the call runs
     once_file: str = ""  # cross-process one-shot latch for crash
+    # Cross-process ARMING window: when set, the entry fires only while
+    # this file exists. The scenario runner (chaos/scenario.py) creates
+    # and removes the latch at trace events, so a FaultPlan inherited
+    # at process boot can be activated mid-run — e.g. drop faults armed
+    # exactly for the span of a graceful-drain window. While unarmed
+    # the entry is scoped out entirely (match counters do NOT advance),
+    # so nth/every semantics count armed traffic only.
+    armed_file: str = ""
     # runtime state (not part of the spec)
     _count: int = field(default=0, repr=False)
     _fires: int = field(default=0, repr=False)
@@ -132,6 +144,7 @@ class Fault:
             code=d.get("code", "UNAVAILABLE"),
             when=d.get("when", "before"),
             once_file=d.get("once_file", ""),
+            armed_file=d.get("armed_file", ""),
         )
 
 
@@ -207,6 +220,8 @@ class FaultPlan:
                 if f.roles and self.role not in f.roles:
                     continue
                 if f.targets and self.target_id not in f.targets:
+                    continue
+                if f.armed_file and not os.path.exists(f.armed_file):
                     continue
                 f._count += 1
                 if f.max_fires and f._fires >= f.max_fires:

@@ -20,6 +20,7 @@ setup(
     package_data={
         "elasticdl_tpu.data": ["recordio_cpp/*.cc"],
         "elasticdl_tpu.master": ["embedding_cpp/*.cc"],
+        "elasticdl_tpu.chaos": ["traces/*.json"],
     },
     python_requires=">=3.9",
     install_requires=[

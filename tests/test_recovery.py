@@ -434,6 +434,13 @@ def test_shard_version_floor_mirror_and_ps_config():
         # elementwise max, never regressing
         assert servicer.shard_version_floor(0) == 3
         assert servicer.shard_version_floor(1) == 6
+        # the mirror advance counts as applied steps: the exactness
+        # invariant (version == init + applied) the churn-scenario
+        # probes assert must hold in sharded mode too. min(3,5)=3
+        # advanced the mirror; min(2,6)=2 did not.
+        ex = servicer.get_sched_stats({})["exactness"]
+        assert ex["version"] == 3
+        assert ex["version"] == ex["init_version"] + ex["applied_update_steps"]
 
         cfg = servicer.get_ps_config({})
         assert cfg["endpoints"] == group.endpoints
