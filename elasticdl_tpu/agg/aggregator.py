@@ -385,8 +385,10 @@ class AggregatorServicer:
 
         obj = {"version": resp["version"], "vec": resp["vec"]}
         shared = None
-        if self._shm_pub is not None:
-            pub = self._shm_pub.publish(obj)
+        with self._lock:
+            shm_pub = self._shm_pub
+        if shm_pub is not None:
+            pub = shm_pub.publish(obj)
             if pub is not None:
                 ref, view = pub
                 shared = messages.Prepacked(
@@ -434,18 +436,25 @@ class AggregatorServicer:
 
     def attach_wire_stats(self, wire):
         """Point stats() at the hosting RpcServer's WireStats (same
-        contract as PSShardServicer.attach_wire_stats)."""
-        self._wire = wire
+        contract as PSShardServicer.attach_wire_stats). Attachment
+        happens while handler threads may already be serving (the
+        server wires accounting after bind), so the reference swap
+        rides the stats mutex."""
+        with self._lock:
+            self._wire = wire
 
     def attach_admission_stats(self, fn):
-        self._admission_fn = fn
+        with self._lock:
+            self._admission_fn = fn
 
     def attach_shm_publisher(self, pub):
         """Point cohort fan-back at the hosting RpcServer's shm
         broadcast publisher (RpcServer.shm_broadcaster), same contract
         as PSShardServicer.attach_shm_publisher; None when the shm
-        tier is off."""
-        self._shm_pub = pub
+        tier is off. Guarded like attach_wire_stats: the combiner
+        thread reads this mid-flight in _forward_batch."""
+        with self._lock:
+            self._shm_pub = pub
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -458,16 +467,18 @@ class AggregatorServicer:
                 "generation": self.generation,
                 "num_upstream": len(self._ps_endpoints),
             }
-        if self._wire is not None:
-            snap = self._wire.snapshot()
+            wire = self._wire
+            admission_fn = self._admission_fn
+        if wire is not None:
+            snap = wire.snapshot()
             out["bytes_sent"] = snap["bytes_sent"]
             out["bytes_received"] = snap["bytes_received"]
             # per-tier rows so a remote caller (bench smoke, operator)
             # can verify the worker-facing side really rode shm — zero
             # socket-tier bytes is the intra-host acceptance bar
             out["transports"] = snap.get("transports", {})
-        if self._admission_fn is not None:
-            adm = self._admission_fn()
+        if admission_fn is not None:
+            adm = admission_fn()
             if adm:
                 out["admission"] = adm
         return out

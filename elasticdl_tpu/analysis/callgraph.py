@@ -29,13 +29,40 @@ path. ``threading.Condition(self._lock)`` aliases to the wrapped lock
 (acquiring the condition IS acquiring the lock); a bare ``Condition()``
 owns its own. Closures and lambdas get their own nodes — locks held in
 the spawning frame are NOT held when the closure later runs.
+
+On top of the edges the graph infers **thread roles** — which runtime
+thread(s) may execute each function (``roles()``). Role seeds:
+
+- ``threading.Thread(target=f)`` with a resolvable ``f`` starts role
+  ``thread:<qualname of f>`` (the overlap sync thread, the fan-in
+  combiner, the KV mirror ring, the recovery monitor, ...);
+- ``pool.submit(f, ...)`` with a resolvable function reference seeds
+  role ``executor`` (the client fan-out pools);
+- ``async def`` bodies and resolvable references passed to
+  ``on_loop_thread``/``call_soon_threadsafe`` seed role ``loop`` (the
+  LoopCore event loop);
+- a resolvable function reference (or a ``lambda`` calling one) passed
+  as an argument when CONSTRUCTING a class that spawns its own threads
+  inherits those thread roles — this is how the aggregator's
+  ``_forward_batch``, handed to ``CombineBuffer`` as the apply
+  callback, is attributed to the combiner thread;
+- callers can merge extra seeds (the rule layer seeds RPC handler
+  registrations as ``rpc-handler``);
+- everything left unseeded with no resolved caller runs as ``main``.
+
+Roles then propagate caller -> callee over the resolved edges to a
+fixpoint, so a helper reachable from both the main path and a spawn
+target carries both roles. Per-function ``self.<attr>`` reads/writes
+are recorded with the held-lock set at the access
+(``attr_accesses``) — together with roles this is the substrate for
+the thread-provenance race rule.
 """
 
 from __future__ import annotations
 
 import ast
 from collections import deque
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, List, Mapping, Optional, Sequence, Set, Tuple
 
 from elasticdl_tpu.analysis.core import AnalysisContext
 
@@ -120,6 +147,34 @@ class Blocking:
         self.held = held
 
 
+class AttrAccess:
+    """One ``self.<attr>`` read or write inside a function body, with
+    the lock set held at the access site."""
+
+    __slots__ = ("attr", "line", "write", "held")
+
+    def __init__(
+        self, attr: str, line: int, write: bool, held: Tuple[LockId, ...]
+    ):
+        self.attr = attr
+        self.line = line
+        self.write = write
+        self.held = held
+
+
+class Spawn:
+    """One thread/executor/loop entry point: ``target`` starts running
+    on the role implied by ``kind`` ("thread" | "executor" | "loop")."""
+
+    __slots__ = ("kind", "target", "line", "spawner")
+
+    def __init__(self, kind: str, target: FuncKey, line: int, spawner: FuncKey):
+        self.kind = kind
+        self.target = target
+        self.line = line
+        self.spawner = spawner
+
+
 class _ClassInfo:
     def __init__(self, path: str, node: ast.ClassDef):
         self.path = path
@@ -154,6 +209,14 @@ class CallGraph:
         self.edges: Dict[FuncKey, List[CallEdge]] = {}
         self.acquires: Dict[FuncKey, List[Acquire]] = {}
         self.blocking: Dict[FuncKey, List[Blocking]] = {}
+        self.attr_accesses: Dict[FuncKey, List[AttrAccess]] = {}
+        self.spawns: List[Spawn] = []
+        #: (constructed class, function ref passed as ctor arg, line)
+        self._callback_regs: List[Tuple[Tuple[str, str], FuncKey, int]] = []
+        self._entry_held_memo: Dict[
+            tuple, Dict[FuncKey, FrozenSet[LockId]]
+        ] = {}
+        self._roles_memo: Dict[tuple, Dict[FuncKey, FrozenSet[str]]] = {}
         self.classes: Dict[Tuple[str, str], _ClassInfo] = {}
         self.lock_kinds: Dict[LockId, str] = {}
         self._module_funcs: Dict[str, Dict[str, FuncKey]] = {}
@@ -288,6 +351,7 @@ class CallGraph:
         self.edges.setdefault(key, [])
         self.acquires.setdefault(key, [])
         self.blocking.setdefault(key, [])
+        self.attr_accesses.setdefault(key, [])
         local_defs: Dict[str, FuncKey] = {}
         self._walk_block(key, info.node.body, (), cls, local_defs)
 
@@ -318,6 +382,7 @@ class CallGraph:
             self.edges.setdefault(sub, [])
             self.acquires.setdefault(sub, [])
             self.blocking.setdefault(sub, [])
+            self.attr_accesses.setdefault(sub, [])
             # the closure runs with NO inherited held locks
             self._walk_block(sub, stmt.body, (), cls, dict(local_defs))
             return
@@ -372,6 +437,29 @@ class CallGraph:
                 if isinstance(node, ast.Lambda):
                     # treated like a closure: body runs later, lock-free
                     continue
+                if isinstance(node, ast.Attribute) and (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    self.attr_accesses[key].append(
+                        AttrAccess(
+                            node.attr,
+                            node.lineno,
+                            isinstance(node.ctx, (ast.Store, ast.Del)),
+                            held,
+                        )
+                    )
+                    continue
+                if isinstance(node, ast.Subscript) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ):
+                    # self.d[k] = v / del self.d[k]: container mutation
+                    attr = _self_attr(node.value)
+                    if attr:
+                        self.attr_accesses[key].append(
+                            AttrAccess(attr, node.lineno, True, held)
+                        )
+                    continue
                 if not isinstance(node, ast.Call):
                     continue
                 desc = blocking_desc(node)
@@ -379,11 +467,120 @@ class CallGraph:
                     self.blocking[key].append(
                         Blocking(desc, node.lineno, held)
                     )
+                self._scan_spawn(key, node, cls, local_defs)
                 callee = self._resolve_call(key, node, cls, local_defs)
                 if callee is not None:
                     self.edges[key].append(
                         CallEdge(callee, node.lineno, held)
                     )
+
+    #: receiver attribute names that hand a function reference to an
+    #: executor pool / the event loop rather than calling it inline
+    _SUBMIT_ATTRS = ("submit",)
+    _LOOP_CB_ATTRS = ("on_loop_thread", "call_soon_threadsafe")
+
+    def _scan_spawn(
+        self,
+        key: FuncKey,
+        node: ast.Call,
+        cls: Optional[_ClassInfo],
+        local_defs: Dict[str, FuncKey],
+    ) -> None:
+        """Record thread/executor/loop entry points and callback
+        registrations rooted at this call."""
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if fname == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = self._resolve_ref(key, kw.value, cls, local_defs)
+                    if target is not None:
+                        self.spawns.append(
+                            Spawn("thread", target, node.lineno, key)
+                        )
+            return
+        if fname in self._SUBMIT_ATTRS and node.args:
+            target = self._resolve_ref(key, node.args[0], cls, local_defs)
+            if target is not None:
+                self.spawns.append(
+                    Spawn("executor", target, node.lineno, key)
+                )
+            return
+        if fname in self._LOOP_CB_ATTRS and node.args:
+            target = self._resolve_ref(key, node.args[0], cls, local_defs)
+            if target is not None:
+                self.spawns.append(Spawn("loop", target, node.lineno, key))
+            return
+        # constructing a class: function refs (or lambdas calling one)
+        # passed in become callbacks the class may run on ITS threads
+        ctor = self._resolve_ctor_class(key[0], node)
+        if ctor is None:
+            return
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                for sub in ast.walk(arg.body):
+                    if isinstance(sub, ast.Call):
+                        ref = self._resolve_call(key, sub, cls, local_defs)
+                        if ref is not None:
+                            self._callback_regs.append(
+                                (ctor, ref, node.lineno)
+                            )
+                continue
+            ref = self._resolve_ref(key, arg, cls, local_defs)
+            if ref is not None:
+                self._callback_regs.append((ctor, ref, node.lineno))
+
+    def _resolve_ref(
+        self,
+        key: FuncKey,
+        expr: ast.expr,
+        cls: Optional[_ClassInfo],
+        local_defs: Dict[str, FuncKey],
+    ) -> Optional[FuncKey]:
+        """Resolve a bare function REFERENCE (not a call): a local
+        nested def, a module function, an imported symbol, or a bound
+        ``self.m``."""
+        path = key[0]
+        if isinstance(expr, ast.Name):
+            if expr.id in local_defs:
+                return local_defs[expr.id]
+            target = self._module_funcs.get(path, {}).get(expr.id)
+            if target is not None:
+                return target
+            imp = self._imports.get(path, {}).get(expr.id)
+            if imp and imp[0] == "sym":
+                mod = self._resolve_module(imp[1])
+                if mod is not None:
+                    return self._module_funcs.get(mod, {}).get(imp[2])
+            return None
+        if isinstance(expr, ast.Attribute) and (
+            isinstance(expr.value, ast.Name) and expr.value.id == "self"
+        ):
+            if cls is not None and expr.attr in cls.methods:
+                return (cls.path, cls.node.name, expr.attr)
+        return None
+
+    def _resolve_ctor_class(
+        self, path: str, node: ast.Call
+    ) -> Optional[Tuple[str, str]]:
+        """(path, class) the call constructs, if it names an analyzed
+        class: ``C(...)``, ``mod.C(...)``, or a from-imported ``C``."""
+        f = node.func
+        if isinstance(f, ast.Name):
+            return self._resolve_class(path, f.id)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            imp = self._imports.get(path, {}).get(f.value.id)
+            if imp is None:
+                return None
+            if imp[0] == "mod":
+                mod = self._resolve_module(imp[1])
+            else:  # from a import b — b may itself be a module
+                mod = self._resolve_module(f"{imp[1]}.{imp[2]}")
+            if mod is not None and (mod, f.attr) in self.classes:
+                return (mod, f.attr)
+        return None
 
     def _lock_of(
         self, expr: ast.expr, cls: Optional[_ClassInfo], path: str
@@ -497,3 +694,145 @@ class CallGraph:
         if "::" in owner:
             return f"{owner.split('::', 1)[1]}.{attr}"
         return attr
+
+    # -- thread roles --------------------------------------------------------
+
+    def thread_role(self, target: FuncKey) -> str:
+        """Stable role name for a thread entry point."""
+        return f"thread:{self.functions[target].qualname}"
+
+    def entry_held(
+        self, roots: Sequence[FuncKey] = ()
+    ) -> Dict[FuncKey, FrozenSet[LockId]]:
+        """Locks guaranteed held on ENTRY to each function: the
+        intersection over every resolved call site of (caller's entry
+        set ∪ locks held lexically at the call). Thread/executor/loop
+        entry points, ctor-registered callbacks, and `roots` (the rule
+        layer passes RPC handlers) start with the empty set — nothing
+        is held when a thread begins. Greatest fixpoint from an
+        optimistic top, so `with self._lock: self._helper()` lets the
+        helper's accesses count as guarded without a lexical `with` of
+        their own. Like edge resolution itself this is optimistic about
+        UNRESOLVED callers (they contribute nothing), which is the
+        accepted precision trade of the whole graph."""
+        memo_key = tuple(sorted(roots, key=lambda k: (k[0], k[1] or "", k[2])))
+        if memo_key in self._entry_held_memo:
+            return self._entry_held_memo[memo_key]
+        incoming: Dict[FuncKey, List[Tuple[FuncKey, Tuple[LockId, ...]]]] = {}
+        for caller, edges in self.edges.items():
+            for e in edges:
+                if e.callee in self.functions:
+                    incoming.setdefault(e.callee, []).append((caller, e.held))
+        pinned = set(roots)
+        pinned.update(sp.target for sp in self.spawns)
+        pinned.update(ref for _, ref, _ in self._callback_regs)
+        top = object()  # optimistic "every lock" before first evidence
+        entry: Dict[FuncKey, object] = {}
+        for k in self.functions:
+            if k in pinned or k not in incoming:
+                entry[k] = frozenset()
+            else:
+                entry[k] = top
+        changed = True
+        while changed:
+            changed = False
+            for k, inc in incoming.items():
+                if k in pinned or k not in entry:
+                    continue
+                meet: Optional[Set[LockId]] = None
+                for caller, held in inc:
+                    ce = entry.get(caller, frozenset())
+                    if ce is top:
+                        continue
+                    at_call = set(ce) | set(held)  # type: ignore[arg-type]
+                    meet = at_call if meet is None else (meet & at_call)
+                if meet is None:
+                    continue
+                new = frozenset(meet)
+                if entry[k] is top or new != entry[k]:
+                    entry[k] = new
+                    changed = True
+        result = {
+            k: (frozenset() if v is top else v) for k, v in entry.items()
+        }
+        self._entry_held_memo[memo_key] = result  # type: ignore[assignment]
+        return result
+
+    def roles(
+        self,
+        extra_seeds: Optional[Mapping[FuncKey, Sequence[str]]] = None,
+    ) -> Dict[FuncKey, FrozenSet[str]]:
+        """Possible executing roles per function (module docstring).
+
+        `extra_seeds` merges caller-known entry points (the rule layer
+        seeds RPC handler registrations as ``rpc-handler``). Every
+        function ends up with a non-empty role set: unseeded functions
+        nobody resolves a call to are ``main``."""
+        memo_key = tuple(
+            sorted(
+                ((k, tuple(sorted(v))) for k, v in (extra_seeds or {}).items()),
+                key=lambda kv: (kv[0][0], kv[0][1] or "", kv[0][2]),
+            )
+        )
+        if memo_key in self._roles_memo:
+            return self._roles_memo[memo_key]
+        seeds: Dict[FuncKey, Set[str]] = {}
+
+        def seed(key: FuncKey, role: str) -> None:
+            if key in self.functions:
+                seeds.setdefault(key, set()).add(role)
+
+        class_thread_roles: Dict[Tuple[str, str], Set[str]] = {}
+        for sp in self.spawns:
+            if sp.kind == "thread":
+                role = self.thread_role(sp.target)
+                seed(sp.target, role)
+                if sp.target[1] is not None:
+                    class_thread_roles.setdefault(
+                        (sp.target[0], sp.target[1]), set()
+                    ).add(role)
+            elif sp.kind == "executor":
+                seed(sp.target, "executor")
+            else:
+                seed(sp.target, "loop")
+        for key, info in self.functions.items():
+            if isinstance(info.node, ast.AsyncFunctionDef):
+                seed(key, "loop")
+        # ctor-registered callbacks run on the constructed class's
+        # own threads (CombineBuffer's apply callback on the combiner)
+        for ctor, ref, _line in self._callback_regs:
+            for role in class_thread_roles.get(ctor, ()):
+                seed(ref, role)
+        for key, role_seq in (extra_seeds or {}).items():
+            for role in role_seq:
+                seed(key, role)
+        has_caller = {
+            e.callee for edges in self.edges.values() for e in edges
+        }
+        for key in self.functions:
+            if key not in seeds and key not in has_caller:
+                seeds[key] = {"main"}
+        out: Dict[FuncKey, Set[str]] = {
+            k: set(seeds.get(k, ())) for k in self.functions
+        }
+        work = deque(
+            sorted(
+                (k for k in out if out[k]),
+                key=lambda k: (k[0], k[1] or "", k[2]),
+            )
+        )
+        while work:
+            cur = work.popleft()
+            r = out[cur]
+            for edge in self.edges.get(cur, ()):
+                tgt = out.get(edge.callee)
+                if tgt is None or r <= tgt:
+                    continue
+                tgt |= r
+                work.append(edge.callee)
+        result = {
+            k: frozenset(v) if v else frozenset({"main"})
+            for k, v in out.items()
+        }
+        self._roles_memo[memo_key] = result
+        return result

@@ -21,6 +21,8 @@ Rule families (one module each):
 - ``lock-order``           (lock_order.py, interprocedural)
 - ``abort-discipline``     (abort_discipline.py, interprocedural)
 - ``async-discipline``     (async_discipline.py, interprocedural)
+- ``thread-provenance``    (thread_provenance.py, interprocedural)
+- ``exactness-lineage``    (exactness_lineage.py, interprocedural)
 
 The interprocedural families are the edl-verify layer: they run on the repo-wide
 call graph built by analysis/callgraph.py instead of one file at a
@@ -64,6 +66,8 @@ RULE_FAMILIES = (
     "lock-order",
     "abort-discipline",
     "async-discipline",
+    "thread-provenance",
+    "exactness-lineage",
 )
 
 #: internal families emitted by the core itself (always on, never
@@ -77,6 +81,8 @@ VERIFY_FAMILIES = (
     "lock-order",
     "abort-discipline",
     "async-discipline",
+    "thread-provenance",
+    "exactness-lineage",
 )
 
 
@@ -87,6 +93,11 @@ class Finding:
     path: str  # posix path relative to the analysis root
     line: int  # 1-based; NOT part of the baseline key
     message: str  # stable, line-number-free
+    #: inferred thread roles behind the finding (thread-provenance /
+    #: exactness-lineage); empty for families with no role model. NOT
+    #: part of the baseline key — role inference may sharpen without
+    #: invalidating accepted entries.
+    roles: Tuple[str, ...] = ()
 
     @property
     def key(self) -> str:
@@ -292,12 +303,14 @@ def _rule_modules():
         abort_discipline,
         async_discipline,
         env_registry,
+        exactness_lineage,
         fencing_conformance,
         jit_purity,
         lock_discipline,
         lock_order,
         metric_registry,
         rpc_conformance,
+        thread_provenance,
     )
 
     return {
@@ -310,6 +323,8 @@ def _rule_modules():
         "lock-order": lock_order,
         "abort-discipline": abort_discipline,
         "async-discipline": async_discipline,
+        "thread-provenance": thread_provenance,
+        "exactness-lineage": exactness_lineage,
     }
 
 

@@ -1,0 +1,512 @@
+"""exactness-lineage: dedup-key lineage from dispatch to apply.
+
+The exactness block (docs/fault_model.md) rests on one dataflow
+invariant: a logical push carries ONE ``report_key``, pinned before
+its first dispatch, and the PS side registers that key only AFTER the
+versioned mutation succeeds. Every piece is easy to get subtly wrong —
+a key re-derived inside a retry loop turns the shard's dedup ring into
+a no-op (every resend looks fresh), a key registered before the apply
+turns a failed apply into a silently-absorbed duplicate on retry, and
+a new version-mutating RPC that never got a retry classification is a
+double-apply waiting for its first lost response. This family proves
+all three statically:
+
+- ``unpinned-retry-key``          a ``report_key`` is DERIVED (uuid,
+                                  f-string) inside a retry-shaped loop
+                                  instead of pinned ahead of it — the
+                                  clean idiom is
+                                  ``report_key = report_key or
+                                  uuid.uuid4().hex`` before the loop
+                                  (rpc/ps_client.py).
+- ``registration-before-apply``   a dedup registration (a write into a
+                                  ``_seen*`` collection, directly or
+                                  via a helper like
+                                  ``_record_applied``) lexically
+                                  precedes a versioned-state mutation
+                                  in the same function — the clean
+                                  order is apply THEN register
+                                  (master/ps_shard.py), so an apply
+                                  exception leaves the key
+                                  unregistered and the retry gets a
+                                  real second attempt.
+- ``mutating-rpc-unclassified``   a registered RPC handler mutates
+                                  versioned state (writes a
+                                  ``*version*`` attribute, itself or
+                                  through same-file helpers) but its
+                                  method is in neither
+                                  ``IDEMPOTENT_METHODS`` nor
+                                  ``DEDUP_KEYED_METHODS``
+                                  (rpc/policy.py) — nobody decided
+                                  what a resend does.
+
+A loop is retry-shaped when it is ``for <attempt-ish> in range(...)``
+or a ``while`` whose body continues/passes out of an ``except`` —
+iteration loops dispatching NEW work each pass (fresh key per window
+is the CORRECT pinning) are not flagged. ``mutating-rpc-unclassified``
+only runs when the tree declares the policy sets at all, and helper
+reachability stays within the handler's file so every report is local
+enough to act on. Findings carry the inferred thread roles of the
+enclosing function (callgraph ``roles()``) in ``--format json``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from elasticdl_tpu.analysis import callgraph as cg
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+from elasticdl_tpu.analysis.rpc_conformance import (
+    _collect_handlers,
+    _const_str,
+    _policy_sets,
+)
+
+_KEY_NAMES = ("report_key", "report_keys")
+_FRESHNESS_CALLS = {
+    "uuid4",
+    "uuid1",
+    "token_hex",
+    "token_urlsafe",
+    "urandom",
+    "getrandbits",
+}
+_RETRYISH = re.compile(r"attempt|retr|tri(al|es)|backoff|resend", re.I)
+_SEEN_RE = re.compile(r"^_seen")
+_VERSION_RE = re.compile(r"version")
+
+
+def _derives_fresh(node: ast.expr) -> bool:
+    """Does this expression MINT a key (vs passing an existing one)?"""
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.Attribute):
+        return _derives_fresh(node.value)
+    if isinstance(node, ast.BoolOp):
+        return any(_derives_fresh(v) for v in node.values)
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if name in _FRESHNESS_CALLS:
+            return True
+        if isinstance(f, ast.Attribute):
+            return _derives_fresh(f.value)
+    return False
+
+
+def _retry_shaped(loop: ast.stmt) -> bool:
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        it = loop.iter
+        if not (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id == "range"
+        ):
+            return False
+        names = []
+        if isinstance(loop.target, ast.Name):
+            names.append(loop.target.id)
+        for a in it.args:
+            if isinstance(a, ast.Name):
+                names.append(a.id)
+            elif isinstance(a, ast.Attribute):
+                names.append(a.attr)
+        return any(n == "_" or _RETRYISH.search(n) for n in names)
+    if isinstance(loop, ast.While):
+        # while-with-except-that-retries: the failure path loops back
+        for node in ast.walk(loop):
+            if isinstance(node, ast.ExceptHandler):
+                if any(
+                    isinstance(s, (ast.Continue, ast.Pass))
+                    for s in node.body
+                ):
+                    return True
+    return False
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk `fn` excluding nested function/lambda subtrees (those are
+    separate call-graph nodes analyzed on their own)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _key_derivations(loop: ast.stmt) -> List[Tuple[int, str]]:
+    """(line, key name) of every freshly-minted report key in `loop`."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                name = _const_str(k)
+                if name in _KEY_NAMES and v is not None and _derives_fresh(v):
+                    out.append((v.lineno, name))
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in _KEY_NAMES and _derives_fresh(kw.value):
+                    out.append((kw.value.lineno, kw.arg))
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Subscript)
+                and _const_str(t.slice) in _KEY_NAMES
+                and _derives_fresh(node.value)
+            ):
+                out.append((node.lineno, _const_str(t.slice)))
+            elif (
+                isinstance(t, ast.Name)
+                and t.id in _KEY_NAMES
+                and _derives_fresh(node.value)
+                and not _reuses_name(node.value, t.id)
+            ):
+                out.append((node.lineno, t.id))
+    return out
+
+
+def _reuses_name(value: ast.expr, name: str) -> bool:
+    """``report_key = report_key or uuid4().hex`` is the PINNING idiom,
+    not a re-derivation — the existing key short-circuits."""
+    return any(
+        isinstance(n, ast.Name) and n.id == name for n in ast.walk(value)
+    )
+
+
+def _unpinned_retry_findings(
+    ctx: AnalysisContext,
+    g: cg.CallGraph,
+    roles: Dict[cg.FuncKey, frozenset],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for key, info in sorted(
+        g.functions.items(), key=lambda kv: (kv[0][0], kv[0][1] or "", kv[0][2])
+    ):
+        qual = info.qualname
+        for node in _own_nodes(info.node):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            if not _retry_shaped(node):
+                continue
+            for line, key_name in sorted(set(_key_derivations(node))):
+                findings.append(
+                    Finding(
+                        rule="exactness-lineage",
+                        check="unpinned-retry-key",
+                        path=key[0],
+                        line=line,
+                        message=(
+                            f"{qual} derives {key_name!r} inside a "
+                            "retry loop — every resend mints a fresh "
+                            "key and the shard dedup ring can never "
+                            "absorb the replay; pin the key before "
+                            "the loop (`report_key = report_key or "
+                            "uuid.uuid4().hex`)"
+                        ),
+                        roles=tuple(sorted(roles.get(key, ()))),
+                    )
+                )
+    return findings
+
+
+def _seen_write_lines(fn: ast.AST) -> List[int]:
+    """Lines where `fn` REGISTERS into a ``_seen*`` collection:
+    subscript/attribute stores and ``.add``/``.append`` mutator calls.
+    Membership reads (the dedup check itself) don't count."""
+    out: List[int] = []
+    for node in _own_nodes(fn):
+        if isinstance(node, (ast.Subscript, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), (ast.Store, ast.Del)
+        ):
+            attr = _self_seen_attr(
+                node.value if isinstance(node, ast.Subscript) else node
+            )
+            if attr:
+                out.append(node.lineno)
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if node.func.attr in ("add", "append", "setdefault"):
+                if _self_seen_attr(node.func.value):
+                    out.append(node.lineno)
+    return out
+
+
+def _self_seen_attr(node: ast.expr) -> Optional[str]:
+    attr = cg._self_attr(node)
+    if attr and _SEEN_RE.search(attr):
+        return attr
+    return None
+
+
+def _version_write_lines(fn: ast.AST) -> List[int]:
+    out: List[int] = []
+    for node in _own_nodes(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                attr = cg._self_attr(t)
+                if attr and _VERSION_RE.search(attr):
+                    out.append(node.lineno)
+    return out
+
+
+def _registration_order_findings(
+    ctx: AnalysisContext,
+    g: cg.CallGraph,
+    roles: Dict[cg.FuncKey, frozenset],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for (path, cls_name), info in sorted(g.classes.items()):
+        # direct events per method, then one transitive hop through
+        # same-class helpers (handler -> _push_locked -> _record_applied)
+        direct_seen = {
+            m: _seen_write_lines(fn) for m, fn in info.methods.items()
+        }
+        direct_ver = {
+            m: _version_write_lines(fn) for m, fn in info.methods.items()
+        }
+        if not any(direct_seen.values()) or not any(direct_ver.values()):
+            continue
+        reg_methods = _closure(info, {m for m, v in direct_seen.items() if v})
+        ver_methods = _closure(info, {m for m, v in direct_ver.items() if v})
+        for m, fn in sorted(info.methods.items()):
+            if m == "__init__":
+                continue
+            bad = _ordered_violations(fn, reg_methods, ver_methods)
+            if bad:
+                key = (path, cls_name, m)
+                findings.append(
+                    Finding(
+                        rule="exactness-lineage",
+                        check="registration-before-apply",
+                        path=path,
+                        line=min(bad),
+                        message=(
+                            f"{cls_name}.{m} registers a dedup key "
+                            "before the versioned-state mutation "
+                            "completes — a failed apply would answer "
+                            "the retry as an already-applied "
+                            "duplicate, silently losing the report; "
+                            "register only after the apply succeeds"
+                        ),
+                        roles=tuple(sorted(roles.get(key, ()))),
+                    )
+                )
+    return findings
+
+
+def _stmt_events(
+    stmt: ast.stmt, reg_methods: Set[str], ver_methods: Set[str]
+) -> List[Tuple[str, int]]:
+    """("reg"/"ver", line) events of ONE statement, nested branches
+    excluded (handled by the sequential walk). A dual-purpose call
+    (helper that applies then registers) yields "ver" before "reg" so
+    it never pairs with itself."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.Subscript, ast.Attribute)) and isinstance(
+            getattr(node, "ctx", None), (ast.Store, ast.Del)
+        ):
+            if _self_seen_attr(
+                node.value if isinstance(node, ast.Subscript) else node
+            ):
+                out.append(("reg", node.lineno))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                attr = cg._self_attr(t)
+                if attr and _VERSION_RE.search(attr):
+                    out.append(("ver", node.lineno))
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            f = node.func
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                if f.attr in ver_methods:
+                    out.append(("ver", node.lineno))
+                if f.attr in reg_methods:
+                    out.append(("reg", node.lineno))
+            elif f.attr in ("add", "append", "setdefault") and _self_seen_attr(
+                f.value
+            ):
+                out.append(("reg", node.lineno))
+    return out
+
+
+def _ordered_violations(
+    fn: ast.AST, reg_methods: Set[str], ver_methods: Set[str]
+) -> List[int]:
+    """Registration lines that a later apply follows on SOME control
+    path. Sequential within a statement list; exclusive if/else
+    branches are walked separately (a fast-path register never pairs
+    with the sibling slow-path apply), and regs live at a branch's end
+    stay live after it (any branch's register followed by a later
+    apply is still a violation)."""
+    bad: List[int] = []
+
+    def walk(stmts, live_regs: List[int]) -> List[int]:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                out_live: List[int] = []
+                for branch in (stmt.body, stmt.orelse):
+                    out_live.extend(walk(branch, list(live_regs)))
+                live_regs = sorted(set(out_live))
+                continue
+            if isinstance(stmt, ast.Try):
+                live = walk(stmt.body, live_regs)
+                for h in stmt.handlers:
+                    live = walk(h.body, live)
+                live = walk(stmt.orelse, live)
+                live_regs = walk(stmt.finalbody, live)
+                continue
+            body = getattr(stmt, "body", None)
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While, ast.With)):
+                for kind, line in _stmt_events(
+                    _header_only(stmt), reg_methods, ver_methods
+                ):
+                    live_regs = _feed(kind, line, live_regs)
+                live_regs = walk(body, live_regs)
+                live_regs = walk(getattr(stmt, "orelse", []), live_regs)
+                continue
+            for kind, line in sorted(
+                _stmt_events(stmt, reg_methods, ver_methods),
+                key=lambda kl: (kl[1], kl[0] == "reg"),
+            ):
+                live_regs = _feed(kind, line, live_regs)
+        return live_regs
+
+    def _feed(kind: str, line: int, live_regs: List[int]) -> List[int]:
+        if kind == "ver":
+            bad.extend(live_regs)
+            return []
+        return live_regs + [line]
+
+    walk(getattr(fn, "body", []), [])
+    return sorted(set(bad))
+
+
+def _header_only(stmt: ast.stmt) -> ast.stmt:
+    """A copy of a compound statement with its body emptied, so
+    _stmt_events sees only header expressions (iter/test/items)."""
+    import copy
+
+    shallow = copy.copy(stmt)
+    shallow.body = []
+    if hasattr(shallow, "orelse"):
+        shallow.orelse = []
+    if hasattr(shallow, "finalbody"):
+        shallow.finalbody = []
+    if hasattr(shallow, "handlers"):
+        shallow.handlers = []
+    return shallow
+
+
+def _closure(info, start: Set[str]) -> Set[str]:
+    """`start` plus same-class methods reaching one of them via a
+    direct ``self.<m>()`` call (fixpoint)."""
+    out = set(start)
+    changed = True
+    while changed:
+        changed = False
+        for m, fn in info.methods.items():
+            if m in out:
+                continue
+            for node in _own_nodes(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in out
+                ):
+                    out.add(m)
+                    changed = True
+                    break
+    return out
+
+
+def _unclassified_findings(
+    ctx: AnalysisContext,
+    g: cg.CallGraph,
+    roles: Dict[cg.FuncKey, frozenset],
+) -> List[Finding]:
+    policy = _policy_sets(ctx)
+    if not policy:
+        return []  # no retry-policy model in this tree
+    classified: Set[str] = set()
+    for _name, (_path, _line, methods) in policy.items():
+        classified |= methods
+    findings: List[Finding] = []
+    for method, regs in sorted(_collect_handlers(ctx).items()):
+        if method in classified:
+            continue
+        for h in regs:
+            if h.func is None or h.cls is None:
+                continue
+            start = (h.path, h.cls.name, h.func.name)
+            if start not in g.functions:
+                continue
+            mutated = _reachable_version_write(g, start)
+            if mutated is None:
+                continue
+            findings.append(
+                Finding(
+                    rule="exactness-lineage",
+                    check="mutating-rpc-unclassified",
+                    path=h.path,
+                    line=h.func.lineno,
+                    message=(
+                        f"RPC handler {method!r} "
+                        f"({h.cls.name}.{h.func.name}) mutates "
+                        f"versioned state ({mutated!r}) but is in "
+                        "neither IDEMPOTENT_METHODS nor "
+                        "DEDUP_KEYED_METHODS (rpc/policy.py) — decide "
+                        "what a resend does before a lost response "
+                        "double-applies it"
+                    ),
+                    roles=tuple(sorted(roles.get(start, ()))),
+                )
+            )
+    return findings
+
+
+def _reachable_version_write(
+    g: cg.CallGraph, start: cg.FuncKey
+) -> Optional[str]:
+    """Name of a ``*version*`` attribute written by `start` or any
+    same-file function it reaches; None when the handler is read-only."""
+    seen = {start}
+    queue = [start]
+    while queue:
+        cur = queue.pop()
+        for acc in g.attr_accesses.get(cur, ()):
+            if acc.write and _VERSION_RE.search(acc.attr):
+                return acc.attr
+        for edge in g.edges.get(cur, ()):
+            if edge.callee[0] == start[0] and edge.callee not in seen:
+                seen.add(edge.callee)
+                queue.append(edge.callee)
+    return None
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    from elasticdl_tpu.analysis.thread_provenance import handler_role_seeds
+
+    g = cg.CallGraph(ctx)
+    roles = g.roles(handler_role_seeds(ctx))
+    findings = _unpinned_retry_findings(ctx, g, roles)
+    findings.extend(_registration_order_findings(ctx, g, roles))
+    findings.extend(_unclassified_findings(ctx, g, roles))
+    return findings

@@ -1,0 +1,223 @@
+"""thread-provenance: cross-thread attribute races via inferred roles.
+
+The lock-discipline rule proves that accesses to lock-guarded state
+hold the lock, but it has no notion of WHICH thread runs a function —
+an attribute touched from the overlap sync thread and the main loop
+with no lock at all never owned a lock to be disciplined about. This
+family closes that gap: the call graph's thread-role inference
+(analysis/callgraph.py ``roles()``) assigns every function the set of
+runtime roles that may execute it (``main``, ``loop``, ``executor``,
+``rpc-handler``, ``thread:<entry qualname>``), and every
+``self.<attr>`` access carries its held-lock set, so a per-class,
+per-attribute sweep can flag state reachable from two roles with no
+common lock.
+
+Checks:
+
+- ``cross-thread-race``     an attribute written outside ``__init__``
+                            is accessed from >= 2 distinct roles and
+                            the accesses share no common held lock.
+- ``role-owned-violation``  an attribute declared in
+                            ``ROLE_OWNED_ATTRS`` is reached from a
+                            role other than its declared owner.
+- ``bad-role-declaration``  ``ROLE_OWNED_ATTRS`` names a role that
+                            role inference never assigns to any method
+                            of the class (typo guard: a stale
+                            declaration must not silently waive the
+                            race check).
+
+Escape hatches, in order of preference:
+
+- guard the attribute (the common-lock test then passes);
+- declare it in ``SYNC_GUARDED_ATTRS`` (lock-discipline then owns it)
+  or ``LOOP_ONLY_ATTRS`` (async-discipline then owns it);
+- declare it in ``ROLE_OWNED_ATTRS = {"<role>": ("_attr", ...)}``
+  when one role genuinely owns it — the declaration is VALIDATED
+  against the inferred roles, not trusted;
+- an ``# edl-lint: disable=thread-provenance -- <why>`` suppression or
+  a commented baseline entry for happens-before patterns the static
+  model cannot see (state handed off via ``Thread.join``/``Event``).
+
+Like every verify family this runs on the AST alone; roles are a
+conservative overapproximation (an unresolvable call contributes no
+edge, an unseeded uncalled function is ``main``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from elasticdl_tpu.analysis import callgraph as cg
+from elasticdl_tpu.analysis.async_discipline import _declared_loop_only
+from elasticdl_tpu.analysis.core import AnalysisContext, Finding
+from elasticdl_tpu.analysis.lock_discipline import _declared_guarded
+from elasticdl_tpu.analysis.rpc_conformance import _collect_handlers
+
+_DECL_NAME = "ROLE_OWNED_ATTRS"
+
+
+def _declared_role_owned(
+    cls_node: ast.ClassDef,
+) -> Tuple[Dict[str, str], List[Tuple[str, int]], int]:
+    """Parse ``ROLE_OWNED_ATTRS = {"<role>": ("_attr", ...)}`` into
+    (attr -> owner role, [(role, decl line)], decl line). Non-literal
+    shapes are ignored — the declaration is a static contract."""
+    owned: Dict[str, str] = {}
+    roles: List[Tuple[str, int]] = []
+    decl_line = 0
+    for node in cls_node.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Name) and t.id == _DECL_NAME):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        decl_line = node.lineno
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+                continue
+            roles.append((k.value, k.lineno))
+            if not isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                continue
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    owned[el.value] = k.value
+    return owned, roles, decl_line
+
+
+def handler_role_seeds(ctx: AnalysisContext) -> Dict[cg.FuncKey, Set[str]]:
+    """Seed every handlers()-registered method as ``rpc-handler`` (the
+    server dispatch pool / loop dispatcher executes it)."""
+    seeds: Dict[cg.FuncKey, Set[str]] = {}
+    for _method, regs in _collect_handlers(ctx).items():
+        for h in regs:
+            if h.func is None or h.cls is None:
+                continue
+            key = (h.path, h.cls.name, h.func.name)
+            seeds.setdefault(key, set()).add("rpc-handler")
+    return seeds
+
+
+def _is_init(fname: str) -> bool:
+    return fname == "__init__" or fname.startswith("__init__.")
+
+
+def run(ctx: AnalysisContext) -> List[Finding]:
+    g = cg.CallGraph(ctx)
+    seeds = handler_role_seeds(ctx)
+    roles = g.roles(seeds)
+    entry = g.entry_held(tuple(seeds))
+    findings: List[Finding] = []
+
+    # class methods (incl. nested defs) grouped by owning class
+    by_class: Dict[Tuple[str, str], List[cg.FuncKey]] = {}
+    for key in g.functions:
+        path, cls_name, _ = key
+        if cls_name is not None and (path, cls_name) in g.classes:
+            by_class.setdefault((path, cls_name), []).append(key)
+
+    for (path, cls_name), info in sorted(g.classes.items()):
+        suppress_file = ctx.files.get(path)
+        if suppress_file is None or suppress_file.tree is None:
+            continue
+        owned, declared_roles, decl_line = _declared_role_owned(info.node)
+        skip = set(info.lock_attrs)
+        skip |= set(_declared_guarded(info.node))
+        skip |= _declared_loop_only(info.node)
+        skip |= set(info.methods)
+
+        class_roles: Set[str] = set()
+        # attr -> [(access, roles, effective held)], __init__ excluded:
+        # ctor writes happen-before any thread this object spawns
+        per_attr: Dict[
+            str, List[Tuple[cg.AttrAccess, frozenset, frozenset]]
+        ] = {}
+        for key in by_class.get((path, cls_name), ()):
+            fname = key[2]
+            r = roles[key]
+            class_roles |= r
+            if _is_init(fname):
+                continue
+            held_on_entry = entry.get(key, frozenset())
+            for acc in g.attr_accesses.get(key, ()):
+                if acc.attr in skip or acc.attr.startswith("__"):
+                    continue
+                eff = frozenset(acc.held) | held_on_entry
+                per_attr.setdefault(acc.attr, []).append((acc, r, eff))
+
+        for role, line in declared_roles:
+            if role not in class_roles:
+                findings.append(
+                    Finding(
+                        rule="thread-provenance",
+                        check="bad-role-declaration",
+                        path=path,
+                        line=line or decl_line,
+                        message=(
+                            f"{cls_name}.{_DECL_NAME} declares role "
+                            f"{role!r}, but inference assigns this "
+                            f"class only {sorted(class_roles)} — fix "
+                            "the declaration (a typo here would "
+                            "silently waive the race check)"
+                        ),
+                        roles=tuple(sorted(class_roles)),
+                    )
+                )
+
+        for attr, accesses in sorted(per_attr.items()):
+            owner = owned.get(attr)
+            if owner is not None:
+                # the declaration asserts every touch happens on the
+                # owner role; flag only accesses that can NEVER be on
+                # it (owner absent from the access's possible roles)
+                bad = [
+                    (acc, r) for acc, r, _eff in accesses if owner not in r
+                ]
+                if owner in class_roles and bad:
+                    seen = sorted({role for _, r in bad for role in r})
+                    findings.append(
+                        Finding(
+                            rule="thread-provenance",
+                            check="role-owned-violation",
+                            path=path,
+                            line=min(acc.line for acc, _ in bad),
+                            message=(
+                                f"{cls_name}.{attr} is declared owned "
+                                f"by role {owner!r} but is reached "
+                                f"from {seen} — guard it or fix the "
+                                "declaration"
+                            ),
+                            roles=tuple(seen),
+                        )
+                    )
+                continue
+            writes = [acc for acc, _r, _eff in accesses if acc.write]
+            if not writes:
+                continue
+            all_roles = sorted({role for _, r, _eff in accesses for role in r})
+            if len(all_roles) < 2:
+                continue
+            common = set(accesses[0][2])
+            for _acc, _r, eff in accesses[1:]:
+                common &= eff
+            if common:
+                continue
+            findings.append(
+                Finding(
+                    rule="thread-provenance",
+                    check="cross-thread-race",
+                    path=path,
+                    line=min(acc.line for acc in writes),
+                    message=(
+                        f"{cls_name}.{attr} is written and read from "
+                        f"roles {all_roles} with no common lock — "
+                        "guard every access, or declare the attribute "
+                        "in SYNC_GUARDED_ATTRS / LOOP_ONLY_ATTRS / "
+                        f"{_DECL_NAME}"
+                    ),
+                    roles=tuple(all_roles),
+                )
+            )
+    return findings

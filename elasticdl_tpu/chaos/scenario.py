@@ -70,6 +70,7 @@ import random
 import signal
 import sys
 import tempfile
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -528,7 +529,11 @@ class JobRun:
         self.t0: Optional[float] = None
         self.t_end: Optional[float] = None
         self.probes = 0
-        self.ps_dead = False
+        # set by the recovery plane's monitor thread (on_unrecoverable
+        # callback), polled by the scenario driver loop — an Event is
+        # the cross-thread flag with a real happens-before edge, not a
+        # bare bool
+        self.ps_dead = threading.Event()
         self._run_dir = run_dir
         self._cache_dir = cache_dir
         self._worker_env = dict(worker_env)
@@ -635,7 +640,7 @@ class JobRun:
             from elasticdl_tpu.master.recovery import RecoveryPlane
 
             def _unrecoverable(kind, sid):
-                self.ps_dead = True
+                self.ps_dead.set()
 
             self._recovery = RecoveryPlane(
                 self.servicer,
@@ -993,7 +998,7 @@ class ScenarioRunner:
                 )
             running = False
             for run in self._jobs.values():
-                if run.ps_dead:
+                if run.ps_dead.is_set():
                     raise RuntimeError(
                         f"job {run.spec.tag}: unrecoverable PS/KV shard"
                     )

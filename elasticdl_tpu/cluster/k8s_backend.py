@@ -404,6 +404,10 @@ class K8sBackend(PodBackend):
         self._volume = volume
         self._envs = envs or {}
         self._cluster_spec = cluster_spec
+        # the watch thread starts now and reads the callback per event;
+        # set_event_callback publishes it later, so the handoff rides a
+        # lock (a bare attribute swap could drop early pod events)
+        self._cb_lock = threading.Lock()
         self._cb: Optional[Callable[[PodEvent], None]] = None
         # worker_id -> pod-create time, for policy-kill victim ordering
         self._started_at: Dict[int, float] = {}
@@ -412,7 +416,8 @@ class K8sBackend(PodBackend):
         self._watcher.start()
 
     def set_event_callback(self, cb: Callable[[PodEvent], None]):
-        self._cb = cb
+        with self._cb_lock:
+            self._cb = cb
 
     def _owner(self) -> Optional[dict]:
         try:
@@ -597,8 +602,10 @@ class K8sBackend(PodBackend):
                     exit_code = None
                     if phase in (PodPhase.FAILED, PodPhase.SUCCEEDED):
                         exit_code = _container_exit_code(pod)
-                    if self._cb:
-                        self._cb(
+                    with self._cb_lock:
+                        cb = self._cb
+                    if cb:
+                        cb(
                             PodEvent(
                                 wid,
                                 phase,

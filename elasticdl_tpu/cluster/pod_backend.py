@@ -108,7 +108,10 @@ class ProcessBackend(PodBackend):
         self._monitor.start()
 
     def set_event_callback(self, cb: Callable[[PodEvent], None]):
-        self._cb = cb
+        # the monitor thread is already running (started in __init__)
+        # and reads the callback per event — publish it under the lock
+        with self._lock:
+            self._cb = cb
 
     def start_worker(self, worker_id: int, argv: List[str], envs: Dict[str, str]):
         env = dict(os.environ) if self._inherit_env else {}
@@ -158,8 +161,10 @@ class ProcessBackend(PodBackend):
                 proc=proc, log_path=log_path, started_at=time.monotonic()
             )
         logger.info("Started worker %d (pid %d)", worker_id, proc.pid)
-        if self._cb:
-            self._cb(PodEvent(worker_id, PodPhase.RUNNING))
+        with self._lock:
+            cb = self._cb
+        if cb:
+            cb(PodEvent(worker_id, PodPhase.RUNNING))
 
     def delete_worker(self, worker_id: int):
         with self._lock:
@@ -225,9 +230,11 @@ class ProcessBackend(PodBackend):
                     ev.phase,
                     ev.exit_code,
                 )
-                if self._cb:
+                with self._lock:
+                    cb = self._cb
+                if cb:
                     try:
-                        self._cb(ev)
+                        cb(ev)
                     except Exception:
                         logger.exception("pod event callback failed")
             time.sleep(self._poll)

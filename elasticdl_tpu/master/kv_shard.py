@@ -115,8 +115,11 @@ class KVShardServicer:
         # hosting RpcServer's WireStats (attach_wire_stats) — stats
         # parity with PSShardServicer
         self._wire = None
-        # request accounting (handlers run lock-free; these are
-        # monotonic best-effort tallies like _mirrored_writes)
+        # request accounting: handlers run lock-free, so these are
+        # monotonic BEST-EFFORT tallies (a lost increment under handler
+        # concurrency is accepted; carried in the analysis baseline —
+        # the mirror-thread counters above are exact, they ride
+        # _mirror_lock)
         self._lookups = 0
         self._updates = 0
 
@@ -266,9 +269,11 @@ class KVShardServicer:
                     client = RpcClient(endpoint)
                     client_endpoint = endpoint
                 client.call("KVMirror", item, timeout=10.0)
-                self._mirrored_writes += 1
+                with self._mirror_lock:
+                    self._mirrored_writes += 1
             except Exception as e:  # noqa: BLE001 - mirror is best-effort
-                self._mirror_drops += 1
+                with self._mirror_lock:
+                    self._mirror_drops += 1
                 logger.warning(
                     "kv shard %d: mirror write to %s dropped: %s",
                     self.shard_id, endpoint, e,
@@ -335,13 +340,15 @@ class KVShardServicer:
     def stats(self) -> Dict[str, int]:
         with self._mirror_lock:
             mirror_sources = len(self._mirror_stores)
+            mirrored_writes = self._mirrored_writes
+            mirror_drops = self._mirror_drops
         out = {
             "n": len(self._store),
             "generation": self.generation,
             "lookups": self._lookups,
             "updates": self._updates,
-            "mirrored_writes": self._mirrored_writes,
-            "mirror_drops": self._mirror_drops,
+            "mirrored_writes": mirrored_writes,
+            "mirror_drops": mirror_drops,
             "mirror_sources": mirror_sources,
         }
         if self._wire is not None:

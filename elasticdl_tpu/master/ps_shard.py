@@ -415,8 +415,10 @@ class PSShardServicer:
                 else vec.astype(codec.dtype_from_str(form))
             )
             obj = {"version": version, "vec": arr}
-            if self._shm_pub is not None:
-                pub = self._shm_pub.publish(obj)
+            with self._lock:
+                shm_pub = self._shm_pub
+            if shm_pub is not None:
+                pub = shm_pub.publish(obj)
                 if pub is not None:
                     ref, view = pub
                     return messages.Prepacked(
@@ -585,7 +587,7 @@ class PSShardServicer:
             resp["vec"] = self._wire_vec(req)
         return resp
 
-    def push_delta_combined(self, req: dict):
+    def push_delta_combined(self, req: dict):  # edl-lint: disable=exactness-lineage -- deliberately unclassified (rpc/policy.py): a combined forward carries k member keys and is NEVER resent as-is — forward failure errors the members, who each retry DIRECT under their own dedup key
         """One presummed cohort from an aggregator node (agg/): apply
         the combined delta once, register EVERY member report_key, and
         answer with the merged slice the aggregator fans back to all
@@ -801,8 +803,11 @@ class PSShardServicer:
     def attach_shm_publisher(self, pub):
         """Point pull prepacking at the hosting RpcServer's shm
         broadcast publisher (RpcServer.shm_broadcaster), same contract
-        as attach_wire_stats; pass None when the shm tier is off."""
-        self._shm_pub = pub
+        as attach_wire_stats; pass None when the shm tier is off.
+        Guarded: handler threads read the reference mid-flight in
+        _encode_pull_entry, and attachment happens after bind."""
+        with self._lock:
+            self._shm_pub = pub
 
     def stats(self) -> Dict[str, int]:
         """Push accounting (exactness evidence for the chaos tests):

@@ -182,10 +182,16 @@ class RecoveryPlane:
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout=5.0)
-        for t in list(self._workers):
+        # snapshot under the lock (the monitor thread appends per-
+        # recovery workers concurrently), join unlocked — a recovery
+        # worker may itself need the lock to finish
+        with self._lock:
+            workers = list(self._workers)
+        for t in workers:
             t.join(timeout=5.0)
         self._threads = []
-        self._workers = []
+        with self._lock:
+            self._workers = []
 
     # -- status / servicer hooks ---------------------------------------------
 
@@ -298,7 +304,8 @@ class RecoveryPlane:
             daemon=True,
         )
         t.start()
-        self._workers.append(t)
+        with self._lock:
+            self._workers.append(t)
 
     # -- recovery ------------------------------------------------------------
 

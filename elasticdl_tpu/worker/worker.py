@@ -129,6 +129,7 @@ class Worker:
         "_report_lock": (
             "_absorb_staged",
             "_sync_result",
+            "_sync_error",
             "_base_snapshots",
             "_spawn_abs",
         ),
@@ -1786,7 +1787,8 @@ class Worker:
                 try:
                     do_sync()
                 except Exception as e:  # surfaced by _check_sync_error
-                    self._sync_error = e
+                    with self._report_lock:
+                        self._sync_error = e
 
             t = threading.Thread(target=thread_main, daemon=True)
             self._sync_thread = t
@@ -1817,9 +1819,13 @@ class Worker:
 
     def _check_sync_error(self):
         """Surface a failed chained sync: every task whose report is
-        still deferred gets requeued, and local state resets."""
-        if self._sync_error is not None:
+        still deferred gets requeued, and local state resets. The
+        read-and-clear is atomic under `_report_lock` (the sync thread
+        publishes the error there): a bare check racing the publish
+        could both miss this window's error AND clear the next one's."""
+        with self._report_lock:
             err, self._sync_error = self._sync_error, None
+        if err is not None:
             self._flush_deferred_reports(err=f"sync failed: {err}")
             self._reset_local_state()
             raise RuntimeError(f"local-update sync failed: {err}") from err

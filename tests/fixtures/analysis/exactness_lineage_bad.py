@@ -1,0 +1,64 @@
+"""exactness-lineage fixture (violations): a retry loop that mints a
+fresh report_key per attempt (unpinned-retry-key — the shard dedup
+ring can never absorb the resend), a handler that registers the dedup
+key before the versioned apply (registration-before-apply — a failed
+apply answers the retry as a duplicate), and a version-mutating RPC in
+neither retry-policy set (mutating-rpc-unclassified). Loaded as source
+by tests/test_static_analysis.py; never imported."""
+
+import uuid
+
+IDEMPOTENT_METHODS = frozenset({"StubPushDelta", "StubBump"})
+DEDUP_KEYED_METHODS = frozenset({"StubPushDelta"})
+
+
+class ShardStub:
+    def __init__(self):
+        self._version = 0
+        self._seen_reports = {}
+
+    def handlers(self):
+        return {
+            "StubPushDelta": self.push_delta,
+            "StubBump": self.bump,
+            "StubMut": self.mut,  # mutates but nobody classified it
+        }
+
+    def push_delta(self, req):
+        # BAD ORDER: key registered before the apply — an apply
+        # exception leaves the key registered and the retry is
+        # swallowed as a duplicate
+        self._record(req["report_key"])
+        self._version += int(req["steps"])
+        return {"version": self._version}
+
+    def _record(self, key):
+        self._seen_reports[key] = None
+
+    def bump(self, req):
+        self._version += 1
+        return {}
+
+    def mut(self, req):
+        self._version += 1
+        return {}
+
+
+def push_with_retry(client, delta):
+    for attempt in range(3):
+        # BAD: every attempt mints a new key — the resend looks fresh
+        resp = client.call(
+            "StubPushDelta",
+            {"delta": delta, "steps": 1, "report_key": uuid.uuid4().hex},
+        )
+        if resp is not None:
+            return resp
+    return None
+
+
+def bump_once(client):
+    client.call("StubBump", {})
+
+
+def mut_once(client):
+    client.call("StubMut", {})

@@ -1,0 +1,50 @@
+"""Clean twin for exactness-lineage: the report_key is pinned ONCE
+before the retry loop (`key = key or uuid4().hex` — the canonical
+idiom from rpc/ps_client.py), the handler applies THEN registers, and
+every version-mutating RPC is classified in the retry-policy sets.
+Loaded as source by tests/test_static_analysis.py; never imported."""
+
+import uuid
+
+IDEMPOTENT_METHODS = frozenset({"StubPushDelta", "StubBump"})
+DEDUP_KEYED_METHODS = frozenset({"StubPushDelta"})
+
+
+class GoodShardStub:
+    def __init__(self):
+        self._version = 0
+        self._seen_reports = {}
+
+    def handlers(self):
+        return {"StubPushDelta": self.push_delta, "StubBump": self.bump}
+
+    def push_delta(self, req):
+        if req["report_key"] in self._seen_reports:
+            return {"version": self._version, "duplicate": True}
+        self._version += int(req["steps"])  # apply first...
+        self._record(req["report_key"])  # ...register only after
+        return {"version": self._version}
+
+    def _record(self, key):
+        self._seen_reports[key] = None
+
+    def bump(self, req):
+        self._version += 1
+        return {}
+
+
+def push_with_retry(client, delta, report_key=None):
+    # pin the key ahead of the loop: every resend replays the SAME key
+    report_key = report_key or uuid.uuid4().hex
+    for attempt in range(3):
+        resp = client.call(
+            "StubPushDelta",
+            {"delta": delta, "steps": 1, "report_key": report_key},
+        )
+        if resp is not None:
+            return resp
+    return None
+
+
+def bump_once(client):
+    client.call("StubBump", {})

@@ -1,0 +1,35 @@
+"""thread-provenance fixture (violations): a stats-drain thread races
+the main thread on an unguarded counter (cross-thread-race), an
+attribute declared role-owned is read from a non-owner role
+(role-owned-violation), and ROLE_OWNED_ATTRS names a role inference
+never assigns (bad-role-declaration — the typo that would silently
+waive the race check). Loaded as source by
+tests/test_static_analysis.py; never imported."""
+
+import threading
+
+
+class Sampler:
+    # "_owned" really is drained-thread state, but snapshot() (main)
+    # reads it; "thread:Sampler._ghost" is a typo'd role — no such
+    # entry point exists
+    ROLE_OWNED_ATTRS = {
+        "thread:Sampler._drain": ("_owned",),
+        "thread:Sampler._ghost": ("_phantom",),
+    }
+
+    def __init__(self):
+        self._count = 0
+        self._owned = 0
+        self._phantom = 0
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _drain(self):
+        self._count += 1  # racy write: main reads this lock-free
+        self._owned += 1  # fine: this IS the owner role
+
+    def snapshot(self):
+        return (self._count, self._owned)  # race read + owner violation

@@ -1,0 +1,29 @@
+"""Clean twin for thread-provenance: the shared counter rides a lock
+on every access (the common-lock test passes), and the role-owned
+attribute is declared with a REAL role and only ever touched by its
+owner. Loaded as source by tests/test_static_analysis.py; never
+imported."""
+
+import threading
+
+
+class GoodSampler:
+    ROLE_OWNED_ATTRS = {"thread:GoodSampler._drain": ("_owned",)}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._owned = 0
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _drain(self):
+        with self._lock:
+            self._count += 1
+        self._owned += 1  # owner-role only: the declaration holds
+
+    def snapshot(self):
+        with self._lock:
+            return self._count

@@ -23,6 +23,7 @@ from elasticdl_tpu.analysis import callgraph as cg
 from elasticdl_tpu.analysis import fencing_conformance as fc
 from elasticdl_tpu.analysis import lock_order as lo
 from elasticdl_tpu.analysis import rpc_conformance as rc
+from elasticdl_tpu.analysis import thread_provenance as tp
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG_ROOT = os.path.join(REPO_ROOT, "elasticdl_tpu")
@@ -1016,6 +1017,10 @@ ABORT_GOOD = _fixture("abort_good.py")
 ABORT_BAD = _fixture("abort_bad.py")
 ASYNC_GOOD = _fixture("async_good.py")
 ASYNC_BAD = _fixture("async_bad.py")
+THREAD_PROV_GOOD = _fixture("thread_provenance_good.py")
+THREAD_PROV_BAD = _fixture("thread_provenance_bad.py")
+EXACT_GOOD = _fixture("exactness_lineage_good.py")
+EXACT_BAD = _fixture("exactness_lineage_bad.py")
 
 
 def test_fencing_flags_unfenced_handler_and_call_site(tmp_path):
@@ -1270,6 +1275,250 @@ def test_repo_async_uds_server_declares_loop_state():
     assert set(AsyncUdsServer.LOOP_ONLY_ATTRS) == {"_server", "_writers"}
 
 
+# -- edl-verify: thread-provenance ---------------------------------------------
+
+
+def test_thread_provenance_flags_race_and_role_violations(tmp_path):
+    root = _tree(tmp_path, {"mod.py": THREAD_PROV_BAD})
+    findings = run_analysis(root, rules=["thread-provenance"])
+    checks = _checks(findings, "thread-provenance")
+    assert checks == {
+        "cross-thread-race",
+        "role-owned-violation",
+        "bad-role-declaration",
+    }
+    msgs = [f.message for f in findings]
+    assert any("_count" in m and "no common lock" in m for m in msgs)
+    assert any("_owned" in m for m in msgs)
+    # the typo'd declaration is flagged, not silently trusted
+    assert any("thread:Sampler._ghost" in m for m in msgs)
+
+
+def test_thread_provenance_clean_under_all_rules(tmp_path):
+    root = _tree(tmp_path, {"mod.py": THREAD_PROV_GOOD})
+    assert run_analysis(root) == []
+
+
+def test_thread_provenance_findings_carry_roles(tmp_path):
+    # each finding names the inferred role set it was derived from —
+    # the triage handle for deciding owner vs. lock vs. baseline
+    root = _tree(tmp_path, {"mod.py": THREAD_PROV_BAD})
+    findings = run_analysis(root, rules=["thread-provenance"])
+    race = next(f for f in findings if f.check == "cross-thread-race")
+    assert set(race.roles) == {"main", "thread:Sampler._drain"}
+
+
+def test_thread_provenance_entry_held_covers_locked_helpers(tmp_path):
+    # a helper whose EVERY resolved caller holds the lock inherits it
+    # on entry: no false race on the helper's bare increment
+    src = """
+import threading
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(target=self._work, daemon=True)
+
+    def start(self):
+        self._t.start()
+
+    def _work(self):
+        with self._lock:
+            self._bump()
+
+    def _bump(self):
+        self._n += 1  # lock held by every caller
+
+    def read(self):
+        with self._lock:
+            self._bump()
+            return self._n
+"""
+    root = _tree(tmp_path, {"mod.py": src})
+    assert run_analysis(root, rules=["thread-provenance"]) == []
+
+
+def test_thread_provenance_suppression(tmp_path):
+    src = THREAD_PROV_BAD.replace(
+        "    def _drain(self):",
+        "    def _drain(self):  # edl-lint: disable=thread-provenance"
+        " -- drained under an external barrier in this fixture",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["thread-provenance"]), "thread-provenance"
+    )
+    # the race (attributed inside _drain) is suppressed; the
+    # declaration findings outside the block still fire
+    assert "cross-thread-race" not in checks
+    assert "bad-role-declaration" in checks
+
+
+def test_repo_thread_roles_cover_the_runtime():
+    """Role inference discovers the repo's real thread topology — the
+    loop core, the executor pool, RPC handlers, the overlap sync
+    thread, the fan-in combiner, the KV mirror ring, and the recovery
+    monitor. This floor is what makes the race rules mean anything."""
+    ctx = load_context(PKG_ROOT)
+    g = cg.CallGraph(ctx)
+    roles = g.roles(tp.handler_role_seeds(ctx))
+    seen = set().union(*roles.values())
+    assert {
+        "main",
+        "loop",
+        "executor",
+        "rpc-handler",
+        "thread:Worker._sync_local_updates.thread_main",
+        "thread:CombineBuffer._combiner_loop",
+        "thread:KVShardServicer._mirror_loop",
+        "thread:RecoveryPlane._monitor_loop",
+    } <= seen
+    assert len(seen) >= 6
+
+
+def test_repo_agg_forward_path_carries_combiner_role():
+    """AggregatorServicer hands _forward_batch to CombineBuffer's
+    constructor; ctor-callback inheritance must place it on the
+    combiner thread alongside the handler-side flush path."""
+    ctx = load_context(PKG_ROOT)
+    g = cg.CallGraph(ctx)
+    roles = g.roles(tp.handler_role_seeds(ctx))
+    key = ("agg/aggregator.py", "AggregatorServicer", "_forward_batch")
+    assert "thread:CombineBuffer._combiner_loop" in roles[key]
+
+
+def test_repo_worker_declares_sync_error_guarded():
+    """The worker publishes the overlap thread's failure through
+    _sync_error under _report_lock; the SYNC_GUARDED_ATTRS declaration
+    and the runtime table must not drift apart."""
+    from elasticdl_tpu.worker.worker import Worker
+
+    assert "_sync_error" in Worker.SYNC_GUARDED_ATTRS["_report_lock"]
+
+
+def test_cli_json_includes_roles(tmp_path, capsys):
+    root = _tree(tmp_path, {"mod.py": THREAD_PROV_BAD})
+    assert (
+        lint_main(
+            [
+                "--root", root, "--rule", "thread-provenance",
+                "--no-baseline", "--format", "json",
+            ]
+        )
+        == 1
+    )
+    out = json.loads(capsys.readouterr().out)
+    race = next(f for f in out["new"] if f["check"] == "cross-thread-race")
+    assert race["roles"] == ["main", "thread:Sampler._drain"]
+
+
+# -- edl-verify: exactness-lineage ---------------------------------------------
+
+
+def test_exactness_lineage_flags_all_three(tmp_path):
+    root = _tree(tmp_path, {"mod.py": EXACT_BAD})
+    findings = run_analysis(root, rules=["exactness-lineage"])
+    checks = _checks(findings, "exactness-lineage")
+    assert checks == {
+        "unpinned-retry-key",
+        "registration-before-apply",
+        "mutating-rpc-unclassified",
+    }
+    msgs = [f.message for f in findings]
+    assert any("push_with_retry" in m for m in msgs)
+    assert any("push_delta" in m for m in msgs)
+    assert any("StubMut" in m for m in msgs)
+
+
+def test_exactness_lineage_clean_under_all_rules(tmp_path):
+    root = _tree(tmp_path, {"mod.py": EXACT_GOOD})
+    assert run_analysis(root) == []
+
+
+def test_exactness_pinning_idiom_inside_loop_is_clean(tmp_path):
+    # `key = key or uuid4()` INSIDE the loop still pins: the second
+    # iteration reuses the first mint, so the resend replays one key
+    src = EXACT_GOOD.replace(
+        "    report_key = report_key or uuid.uuid4().hex\n"
+        "    for attempt in range(3):",
+        "    for attempt in range(3):\n"
+        "        report_key = report_key or uuid.uuid4().hex",
+    )
+    assert "        report_key = report_key or" in src  # applied
+    root = _tree(tmp_path, {"mod.py": src})
+    assert run_analysis(root, rules=["exactness-lineage"]) == []
+
+
+def test_exactness_order_check_is_branch_aware(tmp_path):
+    # registration on the fast path, apply+register on the EXCLUSIVE
+    # slow path (the ps_shard batch-apply shape): not a violation —
+    # no execution runs the early reg AND the later version write
+    src = """
+IDEMPOTENT_METHODS = frozenset({"Push"})
+DEDUP_KEYED_METHODS = frozenset({"Push"})
+
+
+class S:
+    def __init__(self):
+        self._version = 0
+        self._seen_reports = {}
+
+    def handlers(self):
+        return {"Push": self.push}
+
+    def push(self, req):
+        if req.get("fast"):
+            self._seen_reports[req["report_key"]] = None
+        else:
+            self._apply_locked(req)
+        return {}
+
+    def _apply_locked(self, req):
+        self._version += 1
+        self._seen_reports[req["report_key"]] = None
+
+
+def go(client):
+    client.call("Push", {"report_key": "k"})
+"""
+    root = _tree(tmp_path, {"mod.py": src})
+    assert run_analysis(root, rules=["exactness-lineage"]) == []
+
+
+def test_exactness_lineage_suppression(tmp_path):
+    src = EXACT_BAD.replace(
+        "    def push_delta(self, req):",
+        "    def push_delta(self, req):  # edl-lint: disable="
+        "exactness-lineage -- apply is transactional in this fixture",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["exactness-lineage"]), "exactness-lineage"
+    )
+    assert "registration-before-apply" not in checks
+    assert "unpinned-retry-key" in checks  # outside the block: still on
+
+
+def test_repo_trace_and_agg_knobs_registered():
+    """Satellite audit pin: every EDL_TRACE_*/EDL_AGG_* knob the tree
+    reads is declared in ENV_REGISTRY with a real docstring — the
+    env-registry family enforces the read sites, this pins the six
+    knob names so a rename can't orphan a registry entry."""
+    from elasticdl_tpu.common.constants import ENV_REGISTRY
+
+    for knob in (
+        "EDL_AGG_BATCH",
+        "EDL_AGG_WAIT_MS",
+        "EDL_AGG_UPSTREAM_TIER",
+        "EDL_TRACE_SAMPLE",
+        "EDL_TRACE_SEED",
+        "EDL_TRACE_PROBE_SECS",
+    ):
+        assert knob in ENV_REGISTRY and ENV_REGISTRY[knob].strip(), knob
+
+
 # -- edl-verify: the call-graph engine -----------------------------------------
 
 
@@ -1355,6 +1604,8 @@ def test_cli_rule_selection(tmp_path, rule):
         "lock-order": LOCK_ORDER_BAD,
         "abort-discipline": ABORT_BAD,
         "async-discipline": ASYNC_BAD,
+        "thread-provenance": THREAD_PROV_BAD,
+        "exactness-lineage": EXACT_BAD,
     }
     root = _tree(tmp_path, {"mod.py": sources[rule]})
     assert lint_main(["--root", root, "--rule", rule, "--no-baseline"]) == 1
@@ -1514,6 +1765,18 @@ def test_cli_list_rules(capsys):
     out = capsys.readouterr().out
     for rule in RULE_FAMILIES:
         assert rule in out
+
+
+def test_list_rules_families_are_documented():
+    # the golden gate: a family cannot ship without a
+    # docs/static_analysis.md section naming it
+    with open(
+        os.path.join(REPO_ROOT, "docs", "static_analysis.md"),
+        encoding="utf-8",
+    ) as f:
+        doc = f.read()
+    for rule in RULE_FAMILIES:
+        assert f"`{rule}`" in doc, f"{rule} missing from docs"
 
 
 def test_cli_github_format(tmp_path, capsys):
