@@ -381,6 +381,19 @@ def trace_main(name):
                 f"goodput gap not explained by recomputed records: "
                 f"{explained}"
             )
+    # master-failover headline (master/migration.py): hoist the anchor
+    # job's time-to-adopt so the master-failover traces read like every
+    # other bench — one number, honest nulls when the trace exercised
+    # no master kill
+    anchor = report["jobs"].get(trace.jobs[0].tag) or {}
+    failover = anchor.get("master_failover") or {}
+    report["time_to_adopt_secs"] = failover.get("time_to_adopt_secs")
+    report["failover_mode"] = failover.get("mode")
+    no_failover = (
+        "trace has no kill_master event: no master failover was exercised"
+    )
+    null_reasons["time_to_adopt_secs"] = no_failover
+    null_reasons["failover_mode"] = no_failover
     print(json.dumps(_annotate_nulls(report, null_reasons)))
 
 

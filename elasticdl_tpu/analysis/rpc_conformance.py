@@ -308,7 +308,11 @@ def _collect_call_sites(ctx: AnalysisContext) -> List[_CallSite]:
             idem = None
             if (
                 isinstance(node.func, ast.Attribute)
-                and node.func.attr == "call"
+                # `_call_master` (worker/worker.py) is a forwarding
+                # wrapper: it passes (method, request) verbatim to
+                # RpcClient.call with a one-shot failover retry, so its
+                # sites ARE the call sites of the methods it carries
+                and node.func.attr in ("call", "_call_master")
                 and node.args
             ):
                 method = _const_str(node.args[0])

@@ -13,7 +13,10 @@ workers. This module makes those sequences declarative:
   of the live pool), ``drain`` (SIGTERM scale-down through the policy
   plane — workers flush at a task boundary), ``scale_up``,
   ``spawn_job`` (flash-crowd arrival of a deferred job), ``kill_host``
-  (an aggregator node dies WITH every worker mapped to it), and
+  (an aggregator node dies WITH every worker mapped to it),
+  ``kill_master`` (the master itself dies — hard SIGKILL-shaped crash
+  or planned drain — and a StandbyMaster adopts the job with no
+  checkpoint file, master/migration.py), and
   ``chaos_arm``/``chaos_disarm`` (create/remove the latch file behind
   a FaultPlan entry's ``armed_file``, switching an inherited fault
   spec on for exactly one scenario window — e.g. drops composed into a
@@ -97,20 +100,22 @@ ACTIONS = (
     "chaos_arm",
     "chaos_disarm",
     "kill_host",
+    "kill_master",
 )
 
 _JOB_KEYS = {
     "tag", "records", "epochs", "workers", "minibatch",
     "records_per_task", "local_updates", "num_ps", "num_agg",
     "speculate", "qos", "seed", "standby", "deferred", "extra_args",
+    "master_standby",
 }
 _EVENT_KEYS = {
     "at_progress", "at_records", "at_elapsed", "job", "action",
-    "fraction", "count", "latch", "host", "spawn",
+    "fraction", "count", "latch", "host", "spawn", "mode",
 }
 _TRACE_KEYS = {
     "name", "seed", "description", "jobs", "events", "chaos", "expect",
-    "baseline", "time_limit_secs",
+    "baseline", "time_limit_secs", "gap_explained_tolerance",
 }
 _EXPECT_KEYS = {
     "min_relaunches", "min_promotions", "min_policy_stops",
@@ -141,6 +146,9 @@ class JobSpec:
     standby: int = 0
     deferred: bool = False
     extra_args: List[str] = field(default_factory=list)
+    # boot a StandbyMaster beside the job so a kill_master event can
+    # exercise checkpoint-free adoption (master/migration.py)
+    master_standby: bool = False
 
     @property
     def total(self) -> int:
@@ -163,6 +171,7 @@ class TraceEvent:
     latch: str = ""
     host: int = -1
     spawn: str = ""
+    mode: str = ""  # kill_master: "sigkill" (crash) | "handoff" (drain)
 
     def due(self, completed: int, total: int, elapsed: float) -> bool:
         if self.at_elapsed is not None:
@@ -184,6 +193,9 @@ class TraceSpec:
     expect: Dict[str, int]
     baseline: bool
     time_limit_secs: float
+    # when set, every job's gap_explained must land within this of 1.0
+    # — the goodput gap is explained by the recompute counter
+    gap_explained_tolerance: Optional[float] = None
 
     def job(self, tag: str) -> JobSpec:
         for j in self.jobs:
@@ -221,6 +233,7 @@ def _parse_job(d: dict, idx: int) -> JobSpec:
         standby=int(d.get("standby", 0)),
         deferred=bool(d.get("deferred", False)),
         extra_args=[str(a) for a in d.get("extra_args", [])],
+        master_standby=bool(d.get("master_standby", False)),
     )
     if spec.workers < 1:
         raise TraceError(f"job {spec.tag!r}: workers must be >= 1")
@@ -237,6 +250,13 @@ def _parse_job(d: dict, idx: int) -> JobSpec:
         )
     if spec.num_agg > 0 and spec.num_ps <= 0:
         raise TraceError(f"job {spec.tag!r}: num_agg requires num_ps")
+    if spec.master_standby and spec.num_ps <= 0:
+        # checkpoint-free adoption needs the model to live somewhere
+        # that survives the master — the PS shards
+        raise TraceError(
+            f"job {spec.tag!r}: master_standby requires num_ps > 0 "
+            "(the model must outlive the master on PS shards)"
+        )
     return spec
 
 
@@ -274,6 +294,7 @@ def _parse_event(d: dict, idx: int, jobs: List[JobSpec],
         latch=str(d.get("latch", "")),
         host=int(d.get("host", -1)),
         spawn=str(d.get("spawn", "")),
+        mode=str(d.get("mode", "")),
     )
     if ev.at_progress is not None and not 0.0 <= ev.at_progress <= 1.0:
         raise TraceError(f"events[{idx}]: at_progress must be in [0,1]")
@@ -305,6 +326,18 @@ def _parse_event(d: dict, idx: int, jobs: List[JobSpec],
             raise TraceError(
                 f"events[{idx}]: kill_host host {ev.host} out of range "
                 f"for job {job!r} (num_agg={target.num_agg})"
+            )
+    if action == "kill_master":
+        if ev.mode not in ("sigkill", "handoff"):
+            raise TraceError(
+                f"events[{idx}]: kill_master needs mode 'sigkill' or "
+                f"'handoff', got {ev.mode!r}"
+            )
+        target = next(j for j in jobs if j.tag == job)
+        if not target.master_standby:
+            raise TraceError(
+                f"events[{idx}]: kill_master target job {job!r} must "
+                "declare master_standby (a standby to adopt the job)"
             )
     return ev
 
@@ -359,6 +392,15 @@ def parse_trace(raw: dict) -> TraceSpec:
                 f"deferred job {j.tag!r} must be spawned by exactly one "
                 f"spawn_job event (found {spawned.count(j.tag)})"
             )
+    master_kills = [e.job for e in events if e.action == "kill_master"]
+    for tag in set(master_kills):
+        if master_kills.count(tag) > 1:
+            # one standby per job: a second kill would have no master
+            # left waiting to adopt
+            raise TraceError(
+                f"job {tag!r} has {master_kills.count(tag)} kill_master "
+                "events; at most one per job (one standby)"
+            )
 
     expect = raw.get("expect") or {}
     _reject_unknown(expect, _EXPECT_KEYS, "expect")
@@ -373,6 +415,11 @@ def parse_trace(raw: dict) -> TraceSpec:
         expect={k: int(v) for k, v in expect.items()},
         baseline=bool(raw.get("baseline", False)),
         time_limit_secs=float(raw.get("time_limit_secs", 1800.0)),
+        gap_explained_tolerance=(
+            float(raw["gap_explained_tolerance"])
+            if "gap_explained_tolerance" in raw
+            else None
+        ),
     )
 
 
@@ -472,10 +519,13 @@ class ScenarioScheduler:
 
 def compute_goodput(counters: Dict[str, int], elapsed: float) -> dict:
     """Turn the dispatcher's goodput counters into rates. The defining
-    identity — raw - goodput == recomputed/elapsed — holds exactly by
-    construction; `gap_explained` reports the ratio so a scenario can
-    assert its goodput/raw gap is explained by the recompute counter
-    (1.0 when there was any gap; None for a gapless fault-free run).
+    identity — raw - net == recomputed/elapsed — holds exactly by
+    construction (goodput_images_per_sec is the net clamped at zero:
+    a job can spend more on recompute than its total unique records,
+    but cannot have negative useful throughput); `gap_explained`
+    reports the ratio over the unclamped gap so a scenario can assert
+    its goodput/raw gap is explained by the recompute counter (1.0
+    when there was any gap; None for a gapless fault-free run).
 
     drain_flushed_records is deliberately NOT in the arithmetic: a
     drain flush is real work counted once (it is also never inside
@@ -484,14 +534,16 @@ def compute_goodput(counters: Dict[str, int], elapsed: float) -> dict:
     same task)."""
     completed = int(counters.get("completed_records", 0))
     recomputed = int(counters.get("recomputed_records", 0))
-    if recomputed > completed:
-        raise ValueError(
-            f"recomputed_records {recomputed} > completed_records "
-            f"{completed}: counter corruption"
-        )
     raw = completed / elapsed if elapsed > 0 else 0.0
-    good = (completed - recomputed) / elapsed if elapsed > 0 else 0.0
-    gap = raw - good
+    # recomputed can legitimately EXCEED completed: recompute is
+    # charged per PRIOR dispatch at success, so a task that needed
+    # three dispatches (worker death requeue + master-cutover
+    # requeue_doing, say) contributes 2x its records — the net useful
+    # rate clamps at zero while the gap stays UNCLAMPED so the
+    # defining identity above remains testable via gap_explained
+    net = (completed - recomputed) / elapsed if elapsed > 0 else 0.0
+    good = max(0.0, net)
+    gap = raw - net
     return {
         "raw_images_per_sec": raw,
         "goodput_images_per_sec": good,
@@ -538,6 +590,12 @@ class JobRun:
         self._cache_dir = cache_dir
         self._worker_env = dict(worker_env)
         self._recovery = None
+        # master-migration plane (master/migration.py): armed when the
+        # spec declares master_standby; kill_master drives it
+        self.standby_master = None
+        self.migration: Optional[dict] = None
+        self._killed_server = None  # stopped in kill_master, skip in stop()
+        self._data_dir = ""
 
     def start(self) -> None:
         from elasticdl_tpu.cluster.pod_backend import ProcessBackend
@@ -603,6 +661,15 @@ class JobRun:
             log_dir=os.path.join(self._run_dir, f"logs-{spec.tag}")
         )
         addr = f"localhost:{self.server.port}"
+        self.addr = addr
+        self._data_dir = data_dir
+        worker_envs = {
+            "JAX_PLATFORMS": "cpu",
+            **resolve_compile_cache_envs(args),
+            **self._worker_env,
+        }
+        if spec.master_standby:
+            self._boot_standby(args, worker_envs)
         self.manager = WorkerManager(
             self.backend,
             self.dispatcher,
@@ -610,11 +677,7 @@ class JobRun:
             worker_argv_fn=lambda wid: worker_forward_args(
                 args, wid, addr
             ),
-            envs={
-                "JAX_PLATFORMS": "cpu",
-                **resolve_compile_cache_envs(args),
-                **self._worker_env,
-            },
+            envs=worker_envs,
             max_relaunches=4 * spec.workers,
             num_standby=spec.standby,
         )
@@ -652,6 +715,15 @@ class JobRun:
             self.servicer.set_recovery_plane(self._recovery)
             self._recovery.start()
             self.manager.on_shard_failure = self._recovery.on_shard_failure
+        if self.standby_master is not None:
+            from elasticdl_tpu.master.migration import (
+                attach_manifest_publisher,
+            )
+
+            attach_manifest_publisher(
+                self.servicer, self.dispatcher, self.manager
+            )
+            self.standby_master.start()
         self.manager.start_workers()
         logger.info(
             "scenario job %s: %d workers on %s (total %d records)",
@@ -713,6 +785,209 @@ class JobRun:
             "agg_killed": bool(agg_pid),
         }
 
+    # -- master migration (master/migration.py) ----------------------------
+
+    def _boot_standby(self, args, worker_envs: Dict[str, str]) -> None:
+        """Boot a StandbyMaster beside the incumbent: a second
+        servicer/dispatcher pair over the SAME shard groups (no new
+        shards), gated UNAVAILABLE until adoption. Its stable address
+        rides every worker's --master_candidates list."""
+        from elasticdl_tpu.api.model_spec import get_model_spec
+        from elasticdl_tpu.common.args import worker_forward_args
+        from elasticdl_tpu.master.main import _finish_build, collect_shards
+        from elasticdl_tpu.master.migration import StandbyMaster
+        from elasticdl_tpu.master.worker_manager import WorkerManager
+
+        spec, incumbent = self.spec, self.servicer
+        data_dir = self._data_dir
+
+        def _pair():
+            mspec = get_model_spec(
+                model_zoo=args.model_zoo,
+                model_def=args.model_def,
+                model_params=args.model_params,
+                dataset_fn=args.dataset_fn,
+                loss=args.loss,
+                optimizer=args.optimizer,
+                eval_metrics_fn=args.eval_metrics_fn,
+                prediction_outputs_processor=(
+                    args.prediction_outputs_processor
+                ),
+            )
+            _, disp, serv, _, _ = _finish_build(
+                args, "training", mspec,
+                incumbent.ps_group, None, None,
+                collect_shards(data_dir), {}, {},
+                kv_group=incumbent.kv_group,
+                agg_group=incumbent.agg_group,
+            )
+            return serv, disp
+
+        def _manager(disp):
+            # constructed only AT adoption: WorkerManager's __init__
+            # takes over the backend's single event callback — that
+            # swap IS the fleet adoption. Relaunched workers (if any)
+            # dial the standby's address as their primary.
+            return WorkerManager(
+                self.backend,
+                disp,
+                num_workers=spec.workers,
+                worker_argv_fn=lambda wid: worker_forward_args(
+                    args, wid, self.standby_master.addr
+                ),
+                envs=worker_envs,
+                max_relaunches=4 * spec.workers,
+                num_standby=spec.standby,
+            )
+
+        # short lease: scenario masters die fast and CI minutes are real
+        self.standby_master = StandbyMaster(
+            self.addr, _pair, manager_fn=_manager,
+            lease_secs=2.0, manifest_secs=0.2,
+        )
+        # every worker learns both candidates at launch
+        args.master_candidates = f"{self.addr},{self.standby_master.addr}"
+
+    def kill_master(self, mode: str) -> dict:
+        """The incumbent master dies. Its RPC server and recovery plane
+        go away; the shard groups, the standby, and the worker fleet
+        are separate processes/threads and survive — that survival is
+        the premise of checkpoint-free adoption.
+
+        ``handoff``: drain first (BeginHandoff → quiesced manifest,
+        the SIGTERM-preemption shape), then the standby adopts that
+        manifest — nothing requeues, nothing relaunches.
+        ``sigkill``: the primary just disappears; the standby's lease
+        watcher adopts its last cached manifest on its own (the driver
+        loop observes the adoption via poll_migration)."""
+        sb = self.standby_master
+        assert sb is not None, "kill_master needs master_standby"
+        self.migration = {
+            "mode": mode,
+            "t_kill": time.time(),
+            "t_adopted": None,
+            "t_first_progress": None,
+            "baseline_completed": None,
+            "relaunches_at_adopt": None,
+            "adopt_reason": None,
+        }
+        if mode == "handoff":
+            from elasticdl_tpu.master.migration import planned_handoff
+
+            manifest = planned_handoff(self.addr)
+            self._kill_primary()
+            sb.adopt_now(manifest)
+            self._complete_adoption()
+        else:
+            self._kill_primary()
+        return {"mode": mode}
+
+    def _kill_primary(self) -> None:
+        if self._recovery is not None:
+            self._recovery.stop()
+            self._recovery = None
+        self._killed_server = self.server
+        self.server.stop()
+
+    def _complete_adoption(self) -> None:
+        """Swap the run's control-plane refs to the adopting master —
+        from here on every probe and finish check exercises the new
+        master's surfaces — and rebuild the master-main wiring the old
+        master owned (stats surface, standby service, recovery)."""
+        from elasticdl_tpu.master.main import make_sample_batch_fn
+
+        sb = self.standby_master
+        self.dispatcher = sb.dispatcher
+        self.servicer = sb.servicer
+        self.server = sb.server
+        self.manager = sb.manager
+        dispatcher, manager = self.dispatcher, self.manager
+
+        def _stats() -> dict:
+            out = {"workers": manager.snapshot()}
+            out.update(dispatcher.sched_stats())
+            out["goodput"] = dispatcher.goodput_stats()
+            return out
+
+        self.servicer.set_sched_stats_fn(_stats)
+        if self.spec.standby:
+            self.servicer.set_standby_fn(manager.is_standby)
+            self.servicer.set_sample_batch_fn(
+                make_sample_batch_fn(self._data_dir)
+            )
+        if (self.servicer.ps_group is not None
+                or self.servicer.kv_group is not None):
+            from elasticdl_tpu.master.recovery import RecoveryPlane
+
+            def _unrecoverable(kind, sid):
+                self.ps_dead.set()
+
+            self._recovery = RecoveryPlane(
+                self.servicer,
+                ps_group=self.servicer.ps_group,
+                kv_group=self.servicer.kv_group,
+                agg_group=self.servicer.agg_group,
+                on_unrecoverable=_unrecoverable,
+            )
+            self.servicer.set_recovery_plane(self._recovery)
+            self._recovery.start()
+            self.manager.on_shard_failure = self._recovery.on_shard_failure
+        self.migration.update(
+            t_adopted=time.time(),
+            adopt_reason=sb.adopt_reason,
+            baseline_completed=self.dispatcher.completed_records(),
+            relaunches_at_adopt=self.manager.snapshot()["relaunches"],
+        )
+        logger.info(
+            "scenario job %s: standby adopted (%s) %.3fs after the kill",
+            self.spec.tag, sb.adopt_reason,
+            self.migration["t_adopted"] - self.migration["t_kill"],
+        )
+
+    def poll_migration(self) -> None:
+        """Driver-loop hook: finalize a lease-expiry (sigkill) adoption
+        when the watcher fires, and stamp the first post-cutover
+        progress (completed records past the restored baseline)."""
+        sb, mig = self.standby_master, self.migration
+        if sb is None or mig is None:
+            return
+        if mig["t_adopted"] is None:
+            if sb.adopted:
+                self._complete_adoption()
+            return
+        if (mig["t_first_progress"] is None
+                and self.dispatcher.completed_records()
+                > mig["baseline_completed"]):
+            mig["t_first_progress"] = time.time()
+
+    def migration_report(self) -> Optional[dict]:
+        """None when no kill_master fired; otherwise the failover block
+        for the scenario report (time-to-adopt is the headline)."""
+        mig = self.migration
+        if mig is None:
+            return None
+        if mig["t_adopted"] is None:
+            return {"adopted": False, "mode": mig["mode"]}
+        relaunches_after = (
+            self.manager.snapshot()["relaunches"]
+            - mig["relaunches_at_adopt"]
+        )
+        return {
+            "adopted": True,
+            "mode": mig["mode"],
+            "adopt_reason": mig["adopt_reason"],
+            "time_to_adopt_secs": round(
+                mig["t_adopted"] - mig["t_kill"], 3
+            ),
+            "time_to_first_progress_secs": (
+                round(mig["t_first_progress"] - mig["t_kill"], 3)
+                if mig["t_first_progress"] is not None
+                else None
+            ),
+            "manifests_seen": self.standby_master.manifests_seen,
+            "worker_relaunches_after_cutover": relaunches_after,
+        }
+
     def exactness_probe(self) -> dict:
         """One GetSchedStats round — the REAL stats code path, not a
         private-field peek — asserting the master-version invariant.
@@ -764,6 +1039,12 @@ class JobRun:
         return {"stats": st, "versions": list(versions)}
 
     def stop(self) -> None:
+        if self.standby_master is not None:
+            # join the lease watcher; its server is self.server after a
+            # completed adoption (stopped below), still gated otherwise
+            self.standby_master.stop(
+                stop_server=self.standby_master.server is not self.server
+            )
         if self._recovery is not None:
             self._recovery.stop()
         self.manager.stop_relaunch_and_remove_workers()
@@ -785,7 +1066,8 @@ class JobRun:
                         self.spec.tag,
                         exc_info=True,
                     )
-        self.server.stop()
+        if self.server is not self._killed_server:
+            self.server.stop()
 
 
 # -- the runner --------------------------------------------------------------
@@ -869,7 +1151,7 @@ class ScenarioRunner:
     def _execute(self, ev: TraceEvent) -> None:
         sched, job = self.sched, self._jobs.get(ev.job)
         if job is None and ev.action in ("kill", "drain", "scale_up",
-                                         "kill_host"):
+                                         "kill_host", "kill_master"):
             raise RuntimeError(
                 f"trace event {ev.action} anchored to job {ev.job!r} "
                 "which was never spawned"
@@ -908,6 +1190,9 @@ class ScenarioRunner:
         elif ev.action == "kill_host":
             result = job.kill_host(ev.host)
             sched.record("kill_host", ev.job, **result)
+        elif ev.action == "kill_master":
+            result = job.kill_master(ev.mode)
+            sched.record("kill_master", ev.job, **result)
         logger.info("scenario %s: fired %s", self.trace.name,
                     sched.timeline[-1])
 
@@ -1002,6 +1287,7 @@ class ScenarioRunner:
                     raise RuntimeError(
                         f"job {run.spec.tag}: unrecoverable PS/KV shard"
                     )
+                run.poll_migration()
                 done = run.dispatcher.completed_records()
                 if run.t0 is None and done > 0:
                     run.t0 = now
@@ -1063,6 +1349,30 @@ class ScenarioRunner:
                 "expected_version": run.spec.expected_version,
                 "exactness_probes": run.probes,
             }
+            mig = run.migration_report()
+            if mig is not None:
+                assert mig["adopted"], (
+                    f"job {tag}: kill_master fired but the standby "
+                    "never adopted the job"
+                )
+                if mig["mode"] == "handoff":
+                    # the planned-drain contract: the fleet moves with
+                    # the job — nobody restarts
+                    assert mig["worker_relaunches_after_cutover"] == 0, (
+                        f"job {tag}: planned hand-off relaunched "
+                        f"{mig['worker_relaunches_after_cutover']} "
+                        "worker(s); the drained fleet must move as-is"
+                    )
+                jobs_out[tag]["master_failover"] = mig
+            if trace.gap_explained_tolerance is not None:
+                g = goodput["gap_explained"]
+                if g is not None:
+                    assert abs(g - 1.0) <= trace.gap_explained_tolerance, (
+                        f"job {tag}: gap_explained {g} strays more than "
+                        f"{trace.gap_explained_tolerance} from 1.0 — the "
+                        "goodput gap is not explained by the recompute "
+                        "counter"
+                    )
             agg_expect["min_relaunches"] += snap["relaunches"]
             agg_expect["min_promotions"] += snap["promotions"]
             agg_expect["min_policy_stops"] += snap["policy_stops"]

@@ -292,6 +292,10 @@ def add_worker_args(parser: argparse.ArgumentParser):
     """Worker-process flags (reference: worker/main.py:10-83)."""
     parser.add_argument("--worker_id", type=non_neg_int, required=True)
     parser.add_argument("--master_addr", required=True)
+    # master-migration plane (master/migration.py): every endpoint a
+    # master for this job may answer at, comma-separated, primary first;
+    # "" = no in-job failover (exit for relaunch as before)
+    parser.add_argument("--master_candidates", default="")
     # already resolved by the master (resolve_step_pipeline): the
     # worker itself doesn't know the PS staleness policy
     parser.add_argument("--step_pipeline", type=non_neg_int, default=0)
@@ -551,6 +555,8 @@ def worker_forward_args(args, worker_id: int, master_addr: str) -> List[str]:
         argv += ["--sync_compress", args.sync_compress]
     if getattr(args, "overlap_sync", ""):
         argv += ["--overlap_sync", args.overlap_sync]
+    if getattr(args, "master_candidates", ""):
+        argv += ["--master_candidates", args.master_candidates]
     for flag in (
         "model_params",
         "dataset_fn",

@@ -104,6 +104,9 @@ ENV_SCHED_SPECULATE = "EDL_SCHED_SPECULATE"
 ENV_SCHED_SPEC_FACTOR = "EDL_SCHED_SPEC_FACTOR"
 ENV_SCHED_SPEC_PCTL = "EDL_SCHED_SPEC_PCTL"
 ENV_SCHED_MAX_BACKUPS = "EDL_SCHED_MAX_BACKUPS"
+ENV_MIGRATE_LEASE_SECS = "EDL_MIGRATE_LEASE_SECS"
+ENV_MIGRATE_MANIFEST_SECS = "EDL_MIGRATE_MANIFEST_SECS"
+ENV_MIGRATE_STANDBY = "EDL_MIGRATE_STANDBY"
 ENV_TRACE_SAMPLE = "EDL_TRACE_SAMPLE"
 ENV_METRICS_PORT = "EDL_METRICS_PORT"
 ENV_FLIGHT_RECORDER_EVENTS = "EDL_FLIGHT_RECORDER_EVENTS"
@@ -316,6 +319,24 @@ ENV_REGISTRY = {
     ENV_SCHED_MAX_BACKUPS: (
         "speculation: max concurrent backup copies in flight "
         "(default 2)"
+    ),
+    ENV_MIGRATE_LEASE_SECS: (
+        "migration plane: seconds of consecutive failed GetJobManifest "
+        "polls after which a standby master declares the primary dead "
+        "and adopts the job from its last cached manifest "
+        "(master/migration.py; default 3.0)"
+    ),
+    ENV_MIGRATE_MANIFEST_SECS: (
+        "migration plane: seconds between a standby's GetJobManifest "
+        "polls of the primary — the manifest publication cadence, and "
+        "the bound on how much dispatcher state a crash failover "
+        "replays through dedup (default 0.5)"
+    ),
+    ENV_MIGRATE_STANDBY: (
+        "1 arms a standby master for the job (chaos/scenario.py "
+        "master-failover traces; equivalent to the trace's "
+        "master_standby flag): the standby serves UNAVAILABLE until it "
+        "adopts, then answers on its pre-advertised endpoint"
     ),
     ENV_TRACE_SAMPLE: (
         "obs plane: trace sampling probability in [0,1] (default 0 = "

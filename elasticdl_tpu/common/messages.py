@@ -344,6 +344,44 @@ class PSRestoreFromWorkerRequest(_WireRequest):
 
 
 @dataclasses.dataclass
+class GetJobManifestRequest(_WireRequest):
+    """Read of the master's continuously published job manifest — the
+    compact, versioned serialization of everything a standby needs to
+    adopt the running job with no checkpoint file (master/migration.py):
+    dispatcher task/dedup state, servicer exactness counters, shard
+    topology with fencing generations, and the worker-manager roster."""
+
+
+@dataclasses.dataclass
+class BeginHandoffRequest(_WireRequest):
+    """Planned-migration drain latch: the master pauses the task
+    dispatcher (workers get WAIT) so in-flight tasks settle and the
+    manifest quiesces before a standby adopts. Latch-idempotent — a
+    resend finds the dispatcher already paused."""
+
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class PSRefenceRequest(_WireRequest):
+    """In-place fencing-generation bump on a live PS shard — the
+    adoption cutover (master/migration.py). Unlike a relaunch, the
+    slice and optimizer state survive; only the epoch moves, so the old
+    master's stale-generation clients bounce with FAILED_PRECONDITION.
+    Monotonic: generation < current is rejected, == current no-ops."""
+
+    generation: int = -1
+
+
+@dataclasses.dataclass
+class KVRefenceRequest(_WireRequest):
+    """In-place fencing-generation bump on a live KV shard (the KV leg
+    of the adoption cutover; same monotonic contract as PSRefence)."""
+
+    generation: int = -1
+
+
+@dataclasses.dataclass
 class KVLookupRequest(_WireRequest):
     layer: str = ""
     ids: Any = None
@@ -420,6 +458,10 @@ WIRE_SCHEMAS: Dict[str, type] = {
     "ReportWindowMeta": ReportWindowMetaRequest,
     "ReportPhaseStats": ReportPhaseStatsRequest,
     "GetSchedStats": GetSchedStatsRequest,
+    "GetJobManifest": GetJobManifestRequest,
+    "BeginHandoff": BeginHandoffRequest,
+    "PSRefence": PSRefenceRequest,
+    "KVRefence": KVRefenceRequest,
     "GetTrace": GetTraceRequest,
     "GetMetrics": GetMetricsRequest,
     "EmbeddingLookup": EmbeddingLookupRequest,

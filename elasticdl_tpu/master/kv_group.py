@@ -254,6 +254,38 @@ class KVShardGroup:
                 c.close()
         return out
 
+    def refence(self) -> List[int]:
+        """Master-migration cutover (master/migration.py): bump every
+        KV shard's fencing generation IN PLACE via KVRefence — the
+        store and mirror wiring survive while the deposed master's
+        stale-generation traffic starts bouncing FAILED_PRECONDITION
+        (see PSShardGroup.refence for the full contract)."""
+        from elasticdl_tpu.rpc.client import RpcClient
+
+        for i, endpoint in enumerate(self.endpoints):
+            target = self.generations[i] + 1
+            c = RpcClient(endpoint)
+            try:
+                c.call("KVRefence", {"generation": target}, timeout=10.0)
+            finally:
+                c.close()
+            self.generations[i] = target
+            from elasticdl_tpu.obs import flight as obs_flight
+
+            obs_flight.record(
+                "generation_bump",
+                shard_kind="kv",
+                shard=i,
+                generation=target,
+                refence=True,
+            )
+        if self._store is not None:
+            self._store.update_endpoints(self.endpoints, self.generations)
+        logger.info(
+            "KV shard group refenced: generations=%s", self.generations
+        )
+        return list(self.generations)
+
     def store(self) -> ShardedEmbeddingStore:
         """The master's store client (SparseOptimizer + checkpoints)."""
         if self._store is None:

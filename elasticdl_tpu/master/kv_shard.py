@@ -87,16 +87,21 @@ class KVShardServicer:
     # GetTrace/GetMetrics answer for the PROCESS (spans/metrics survive
     # a fence and are exactly what a postmortem wants from a fenced
     # shard), so they skip the epoch check too.
+    # KVRefence is the fence MOVER (master-migration cutover): it
+    # carries the new generation, so it cannot pass a check against the
+    # old one — its own monotonicity check is its fence.
     UNFENCED_HANDLERS = frozenset(
         {"KVMirror", "KVMirrorSnapshot", "KVSetMirror",
-         "GetTrace", "GetMetrics"}
+         "KVRefence", "GetTrace", "GetMetrics"}
     )
 
     def __init__(self, shard_id: int, num_shards: int, generation: int = 0):
         self.shard_id = int(shard_id)
         self.num_shards = int(num_shards)
-        # fencing epoch (see rpc/fencing.py): immutable per servicer, a
-        # relaunch constructs a new one at the bumped generation
+        # fencing epoch (see rpc/fencing.py): a relaunch constructs a
+        # new servicer at the bumped generation; a master-migration
+        # cutover moves it in place via KVRefence (written under
+        # _mirror_lock; bare int reads in _check_epoch cannot tear)
         self.generation = int(generation)
         self._store = EmbeddingStore()
         # outbound mirroring (this shard as primary)
@@ -133,9 +138,33 @@ class KVShardServicer:
             "KVMirror": self.kv_mirror,
             "KVMirrorSnapshot": self.kv_mirror_snapshot,
             "KVSetMirror": self.kv_set_mirror,
+            "KVRefence": self.refence,
             "GetTrace": self.get_trace,
             "GetMetrics": self.get_metrics,
         }
+
+    def refence(self, req: dict) -> dict:  # edl-lint: disable=thread-provenance -- self.generation is a single int word: a torn read is impossible, the bump is monotonic under self._mirror_lock, and a request racing the move is rejected either way
+        """In-place fencing-generation bump (the KV leg of the
+        master-migration cutover; see PSShardServicer.refence). The
+        store and mirror wiring survive — only the epoch moves, so the
+        deposed master's stale-generation traffic starts bouncing with
+        FAILED_PRECONDITION. Monotonic: == current no-ops (retried
+        bump), < current is rejected as the stale caller it is."""
+        from elasticdl_tpu.rpc.fencing import EpochFencedError
+
+        target = int(req.get("generation", -1))
+        with self._mirror_lock:
+            if target < self.generation:
+                raise EpochFencedError(
+                    "kv", self.shard_id, self.generation, target
+                )
+            if target > self.generation:
+                logger.info(
+                    "KV shard %d refenced: generation %d -> %d",
+                    self.shard_id, self.generation, target,
+                )
+                self.generation = target
+            return {"generation": self.generation}
 
     def get_trace(self, req: dict) -> dict:
         """This process's SpanRecorder contents (obs/trace.py)."""
@@ -152,7 +181,7 @@ class KVShardServicer:
 
         return {"metrics": obs_metrics.get_registry().snapshot()}
 
-    def _check_epoch(self, req: dict):
+    def _check_epoch(self, req: dict):  # edl-lint: disable=lock-discipline -- deliberate bare read of the single int epoch word: a request racing the refence bump is rejected either way, and taking self._mirror_lock here would serialize every fence check against mirror forwarding
         from elasticdl_tpu.rpc.fencing import check_epoch
 
         check_epoch(req, self.generation, "kv", self.shard_id)
@@ -337,7 +366,7 @@ class KVShardServicer:
 
         reg.register_collector(collector)
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, int]:  # edl-lint: disable=lock-discipline -- generation is a single int word read for a diagnostic snapshot; a value torn against a concurrent refence cannot exist (one-word read) and staleness is fine in stats
         with self._mirror_lock:
             mirror_sources = len(self._mirror_stores)
             mirrored_writes = self._mirrored_writes

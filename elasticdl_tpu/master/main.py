@@ -520,6 +520,12 @@ def main(argv=None) -> int:
         max_relaunches=args.max_worker_relaunches,
         num_standby=args.num_standby_workers,
     )
+    # migration plane (master/migration.py): publish the job manifest
+    # continuously so a standby master can adopt this job with no
+    # checkpoint file — planned hand-off or crash failover
+    from elasticdl_tpu.master.migration import attach_manifest_publisher
+
+    attach_manifest_publisher(servicer, dispatcher, manager)
     if args.num_standby_workers:
         servicer.set_standby_fn(manager.is_standby)
         if args.training_data_dir:

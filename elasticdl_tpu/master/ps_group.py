@@ -314,6 +314,43 @@ class PSShardGroup:
         )
         return self.endpoints[i]
 
+    def refence(self) -> List[int]:
+        """Master-migration cutover (master/migration.py): bump every
+        shard SLOT's fencing generation IN PLACE via the PSRefence RPC
+        — state survives (unlike `relaunch_shard`, which boots a fresh
+        empty servicer), but every client still stamping the old
+        generation, the deposed master above all, bounces with
+        FAILED_PRECONDITION from the moment each shard answers. The
+        group's own mutable `generations` list follows so the adopting
+        master's fan-out client and GetPSConfig advertise the new
+        epochs. Idempotent per target: a retried cutover re-sends
+        `current` which the shard treats as a no-op bump."""
+        from elasticdl_tpu.rpc.client import RpcClient
+
+        for i, endpoint in enumerate(self.endpoints):
+            target = self.generations[i] + 1
+            c = RpcClient(endpoint)
+            try:
+                c.call("PSRefence", {"generation": target}, timeout=10.0)
+            finally:
+                c.close()
+            self.generations[i] = target
+            from elasticdl_tpu.obs import flight as obs_flight
+
+            obs_flight.record(
+                "generation_bump",
+                shard_kind="ps",
+                shard=i,
+                generation=target,
+                refence=True,
+            )
+        if self._client is not None:
+            self._client.update_endpoints(self.endpoints, self.generations)
+        logger.info(
+            "PS shard group refenced: generations=%s", self.generations
+        )
+        return list(self.generations)
+
     def stop(self):
         if self._client is not None:
             self._client.close()

@@ -93,9 +93,12 @@ class PSShardServicer:
         self.shard_id = shard_id
         self.num_shards = num_shards
         # fencing epoch: bumped by the group on every relaunch of this
-        # shard slot; immutable for the servicer's lifetime (a relaunch
-        # constructs a NEW servicer). Requests carrying a different
-        # epoch are rejected hard (rpc/fencing.py).
+        # shard slot (a relaunch constructs a NEW servicer), or moved
+        # in place by PSRefence during a master-migration cutover.
+        # Requests carrying a different epoch are rejected hard
+        # (rpc/fencing.py). Written under self._lock; _check_epoch
+        # reads it bare — a torn read is impossible for an int, and a
+        # request racing the refence is rejected either way.
         self.generation = int(generation)
         self._opt = optimizer
         self._grads_to_wait = grads_to_wait
@@ -177,8 +180,11 @@ class PSShardServicer:
 
     #: Handlers that deliberately skip the fencing epoch check: the obs
     #: reads answer for the PROCESS (spans/metrics survive a fence and
-    #: are exactly what a postmortem wants from a fenced shard).
-    UNFENCED_HANDLERS = frozenset({"GetTrace", "GetMetrics"})
+    #: are exactly what a postmortem wants from a fenced shard), and
+    #: PSRefence IS the fence mover — it carries the NEW generation, so
+    #: it cannot pass a check against the old one; its own monotonicity
+    #: check (reject generation < current) is the fence for it.
+    UNFENCED_HANDLERS = frozenset({"GetTrace", "GetMetrics", "PSRefence"})
 
     def handlers(self) -> Dict[str, Any]:
         return {
@@ -189,9 +195,36 @@ class PSShardServicer:
             "PSPushDeltaCombined": self.push_delta_combined,
             "PSOptState": self.opt_state,
             "PSOptRestore": self.opt_restore,
+            "PSRefence": self.refence,
             "GetTrace": self.get_trace,
             "GetMetrics": self.get_metrics,
         }
+
+    def refence(self, req: dict) -> dict:  # edl-lint: disable=thread-provenance -- self.generation is a single int word (design note at the attribute): a torn read is impossible, the bump is monotonic under self._lock, and a request racing the move is rejected either way
+        """In-place fencing-generation bump — the master-migration
+        cutover (master/migration.py). Unlike a relaunch (which
+        constructs a NEW servicer at the bumped generation and boots
+        empty), a refence moves the epoch under the live slice: state
+        survives, and every client still stamping the old generation —
+        the deposed master and anything it spawned — bounces with
+        FAILED_PRECONDITION from then on. Monotonic and idempotent by
+        target: generation == current answers ok (a retried bump),
+        generation < current is rejected as the stale caller it is."""
+        target = int(req.get("generation", -1))
+        with self._lock:
+            if target < self.generation:
+                from elasticdl_tpu.rpc.fencing import EpochFencedError
+
+                raise EpochFencedError(
+                    "ps", self.shard_id, self.generation, target
+                )
+            if target > self.generation:
+                logger.info(
+                    "PS shard %d refenced: generation %d -> %d",
+                    self.shard_id, self.generation, target,
+                )
+                self.generation = target
+            return {"generation": self.generation}
 
     def get_trace(self, req: dict) -> dict:
         """This process's SpanRecorder contents (obs/trace.py)."""
@@ -262,7 +295,7 @@ class PSShardServicer:
 
         reg.register_collector(collector)
 
-    def _check_epoch(self, req: dict):
+    def _check_epoch(self, req: dict):  # edl-lint: disable=lock-discipline -- deliberate bare read of the single int epoch word (design note at the attribute): a request racing the refence bump is rejected either way, and taking self._lock here would serialize every fence check against push appliers
         from elasticdl_tpu.rpc.fencing import check_epoch
 
         check_epoch(req, self.generation, "ps", self.shard_id)

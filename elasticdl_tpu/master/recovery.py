@@ -86,6 +86,63 @@ RELAUNCHING = "RELAUNCHING"
 RESTORING = "RESTORING"
 
 
+def restore_ps_shard(
+    endpoint: str,
+    generation: int,
+    vec: Any,
+    version: int,
+    fence_version: int = -1,
+    opt_leaves: Any = None,
+    timeout: float = 60.0,
+) -> bool:
+    """Seed a (re)launched PS shard from a restore candidate: PSInit
+    the flat vector at its version, then PSOptRestore the mirrored
+    optimizer leaves when available.
+
+    Deliberately master-agnostic — a plain function of (endpoint,
+    generation, candidate), with no RecoveryPlane/servicer state — so
+    the two callers that must behave identically actually share it:
+    the original master's in-place shard recovery (`_recover_ps`) and
+    a migrating master's adoption path (master/migration.py), which
+    restores shards that died together with the old master from the
+    manifest's floors and whatever uploads/mirrors it inherited.
+
+    Returns True when the restore is version-exact (candidate reached
+    the fence floor), False when it fell short and resume is merely
+    best-available.
+    """
+    from elasticdl_tpu.rpc.client import RpcClient
+
+    exact = version >= fence_version
+    if not exact:
+        logger.warning(
+            "PS shard at %s: restore candidate v%d < fence v%d — "
+            "seeding from it anyway (resume is not version-exact)",
+            endpoint, version, fence_version,
+        )
+    client = RpcClient(endpoint)
+    try:
+        client.call(
+            "PSInit",
+            {"vec": vec, "version": version, "epoch": generation},
+            timeout=timeout,
+        )
+        if opt_leaves is not None:
+            client.call(
+                "PSOptRestore",
+                {"leaves": opt_leaves, "epoch": generation},
+                timeout=timeout,
+            )
+        else:
+            logger.warning(
+                "PS shard at %s: no mirrored optimizer state — "
+                "moments restart cold", endpoint,
+            )
+    finally:
+        client.close()
+    return exact
+
+
 class RecoveryPlane:
     """Master-side controller for PS/KV shard failover."""
 
@@ -364,8 +421,6 @@ class RecoveryPlane:
             self._on_unrecoverable(kind, shard_id)
 
     def _recover_ps(self, shard_id: int):  # edl-lint: disable=lock-discipline -- self._cv wraps self._lock
-        from elasticdl_tpu.rpc.client import RpcClient
-
         group = self._ps_group
         # the restore floor: the highest version the master has SEEN
         # this shard ack (per-shard elementwise-max mirror fed by
@@ -403,37 +458,19 @@ class RecoveryPlane:
             self._give_up("ps", shard_id)
             return
         version, vec = best
-        if version < fence_version:
-            logger.warning(
-                "PS shard %d: best restore upload v%d < fence v%d — "
-                "resuming from it anyway (resume is not version-exact)",
-                shard_id, version, fence_version,
-            )
-        client = RpcClient(endpoint)
-        try:
-            client.call(
-                "PSInit",
-                {"vec": vec, "version": version, "epoch": generation},
-                timeout=60.0,
-            )
-            leaves = None
-            with self._lock:
-                ring = self._opt_rings.get(shard_id)
-                if ring:
-                    leaves = ring[-1]
-            if leaves is not None:
-                client.call(
-                    "PSOptRestore",
-                    {"leaves": leaves, "epoch": generation},
-                    timeout=60.0,
-                )
-            else:
-                logger.warning(
-                    "PS shard %d: no mirrored optimizer state — "
-                    "moments restart cold", shard_id,
-                )
-        finally:
-            client.close()
+        leaves = None
+        with self._lock:
+            ring = self._opt_rings.get(shard_id)
+            if ring:
+                leaves = ring[-1]
+        restore_ps_shard(
+            endpoint,
+            generation,
+            vec,
+            version,
+            fence_version=fence_version,
+            opt_leaves=leaves,
+        )
         # the aggregator nodes hold upstream clients to the old
         # endpoint: re-point them at the moved shard (best-effort — a
         # node that misses it fails its next forward and the members

@@ -162,6 +162,18 @@ class MasterServicer:
         # together they pin "every update applied exactly once".
         self._init_version = init_version
         self._applied_update_steps = 0
+        # migration plane (master/migration.py): the master's OWN
+        # fencing word. Bumped when an adopting master takes over
+        # (cutover = shard refence at gen+1 + this bump); workers read
+        # it from GetPSConfig and treat a higher value as "a new master
+        # owns the job" during candidate probing. Distinct from the
+        # per-shard generations — those fence shard relaunches, this
+        # fences master hand-offs.
+        self._master_generation = 0
+        # adoption keeps get_ps_config's n_params honest before the
+        # template tree is lazily re-established (the manifest carries
+        # the scalar, never the tensors)
+        self._n_params_hint = -1
 
     # -- handler table (the 6 reference RPCs + embedding plane) -------------
 
@@ -185,7 +197,103 @@ class MasterServicer:
             "GetSchedStats": self.get_sched_stats,
             "GetTrace": self.get_trace,
             "GetMetrics": self.get_metrics,
+            "GetJobManifest": self.get_job_manifest,
+            "BeginHandoff": self.begin_handoff,
         }
+
+    # -- migration plane (master/migration.py) -------------------------------
+
+    def set_job_manifest_fn(self, fn):
+        """fn() -> manifest dict; wired by master main / the chaos
+        runner to migration.build_job_manifest over this servicer, its
+        dispatcher and the worker manager. Until wired, GetJobManifest
+        answers {"manifest": None} — a standby treats that the same as
+        an unreachable primary and keeps its last cached manifest."""
+        self._job_manifest_fn = fn
+
+    def get_job_manifest(self, req: dict) -> dict:
+        """The continuously publishable job manifest — everything an
+        adopting master needs short of the model tensors (those live on
+        the PS/KV shards and are restored through the recovery plane's
+        worker-upload/mirror paths, never through this RPC)."""
+        fn = getattr(self, "_job_manifest_fn", None)
+        return {"manifest": fn() if fn is not None else None}
+
+    def begin_handoff(self, req: dict) -> dict:
+        """Planned-migration drain latch: pause the dispatcher (workers
+        WAIT at task boundaries, in-flight reports keep landing) and
+        report whether the doing-map has drained. Latch-idempotent —
+        the standby polls this until quiesced, then adopts from the
+        final manifest."""
+        if self._task_d is None:
+            return {"paused": False, "quiesced": True}
+        reason = req.get("reason") or ""
+        if reason:
+            logger.info("BeginHandoff: draining for hand-off (%s)", reason)
+        self._task_d.pause()
+        return {"paused": True, "quiesced": self._task_d.is_quiesced()}
+
+    @property
+    def master_generation(self) -> int:
+        with self._lock:
+            return self._master_generation
+
+    def set_master_generation(self, generation: int):
+        with self._lock:
+            self._master_generation = max(
+                self._master_generation, int(generation)
+            )
+
+    def export_model_state(self) -> dict:
+        """The servicer's portable control-plane state for the job
+        manifest — version lineage, the per-shard restore floors, and
+        the local-update dedup ring keys. One lock acquisition, so the
+        exactness invariant (version == init + applied) holds inside
+        the snapshot. Deliberately NO tensors: params/aux templates are
+        re-established lazily (ReportVariable / first report's
+        aux_state) and the authoritative values live on the shards."""
+        with self._lock:
+            n = (
+                sum(
+                    int(np.asarray(leaf).size)
+                    for leaf in jax.tree_util.tree_leaves(self._params)
+                )
+                if self._params is not None
+                else self._n_params_hint
+            )
+            vm = self._shard_version_max
+            return {
+                "version": self._version,
+                "init_version": self._init_version,
+                "applied_update_steps": self._applied_update_steps,
+                "shard_version_max": list(vm) if vm is not None else None,
+                "seen_local_updates": list(self._seen_local_updates),
+                "duplicate_local_updates": self._duplicate_local_updates,
+                "n_params": n,
+            }
+
+    def restore_model_state(self, state: dict):
+        """Adopt an exported model-control state. Restoring
+        `shard_version_max` is what keeps shard_version_floor correct
+        for the NEW master's recovery plane — a shard that died
+        together with the old master must still be restored to the
+        floor the old master had mirrored, or the resume silently
+        loses acked steps."""
+        with self._lock:
+            self._version = int(state["version"])
+            self._init_version = int(state["init_version"])
+            self._applied_update_steps = int(state["applied_update_steps"])
+            vm = state.get("shard_version_max")
+            self._shard_version_max = (
+                [int(v) for v in vm] if vm is not None else None
+            )
+            self._seen_local_updates = OrderedDict(
+                (k, True) for k in state.get("seen_local_updates") or ()
+            )
+            self._duplicate_local_updates = int(
+                state.get("duplicate_local_updates", 0)
+            )
+            self._n_params_hint = int(state.get("n_params", -1))
 
     # -- observability plane (elasticdl_tpu/obs/) ----------------------------
 
@@ -729,6 +837,7 @@ class MasterServicer:
                 "agg_endpoints": agg,
                 "agg_generations": agg_gens,
                 "recovering": recovering,
+                "master_generation": self.master_generation,
             }
         with self._lock:
             n = (
@@ -737,8 +846,11 @@ class MasterServicer:
                     for leaf in jax.tree_util.tree_leaves(self._params)
                 )
                 if self._params is not None
-                else -1
+                # adoption window: template not yet re-established but
+                # the manifest told us the true size
+                else self._n_params_hint
             )
+            master_generation = self._master_generation
         return {
             "endpoints": self._ps_group.endpoints,
             "n_params": n,
@@ -748,6 +860,7 @@ class MasterServicer:
             "agg_endpoints": agg,
             "agg_generations": agg_gens,
             "recovering": recovering,
+            "master_generation": master_generation,
         }
 
     # -- recovery plane ------------------------------------------------------

@@ -129,6 +129,11 @@ class TaskDispatcher:
         self._primary_wins = 0
         self._backup_promotions = 0
         self._late_reports = 0
+        # migration plane (master/migration.py): while paused, get()
+        # hands out nothing (workers WAIT at task boundaries) so the
+        # doing-map drains and the exported manifest quiesces before a
+        # planned hand-off cuts over
+        self._paused = False
 
         if self._training_shards:
             logger.info("Starting epoch %d", self._epoch)
@@ -193,6 +198,12 @@ class TaskDispatcher:
         """Pop the next task (todo -> doing); lazily roll the next epoch
         (reference :130-151). Returns None when nothing is available."""
         with self._lock:
+            if self._paused:
+                # drain latch (BeginHandoff): nothing new goes out, but
+                # reports for in-flight tasks keep landing — the worker
+                # sees WAIT, exactly like an exhausted-but-unfinished
+                # epoch boundary
+                return None
             if not self._todo and self._training_shards:
                 if self._epoch < self._num_epochs - 1:
                     self._epoch += 1
@@ -491,3 +502,136 @@ class TaskDispatcher:
         failed by the master exit path."""
         with self._lock:
             return bool(self.failed_tasks)
+
+    # -- migration plane (master/migration.py) -------------------------------
+
+    def pause(self):
+        """Drain latch for a planned master hand-off (BeginHandoff):
+        get() answers None (workers WAIT) until resume(), while
+        report() keeps settling in-flight tasks — the doing-map drains
+        to empty and the exported state quiesces. Latch-idempotent."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self):
+        with self._lock:
+            self._paused = False
+
+    def is_quiesced(self) -> bool:
+        """Paused with nothing in flight: the exported state is final
+        until resume() — the planned hand-off's cut-over condition."""
+        with self._lock:
+            return self._paused and not self._doing
+
+    def export_state(self) -> dict:
+        """The dispatcher's full mutable state as one wire-serializable
+        dict (the job manifest's task section), snapshotted under one
+        lock acquisition so it is internally consistent. Tasks ride as
+        their to_wire dicts WITH their pinned spec_keys — that is what
+        lets an adopting master's re-dispatch of a replayed shard reuse
+        the same window report_keys, so pushes the dead master's worker
+        already landed are absorbed by shard dedup instead of
+        double-applying. `_started` (dispatch wall-clock, meaningless
+        in another process) stays behind; int-keyed maps ride as pair
+        lists so the dict survives canonical-JSON serialization."""
+        with self._lock:
+            return {
+                "schema": 1,
+                "epoch": self._epoch,
+                "task_id": self._task_id,
+                "attempt_seq": self._attempt_seq,
+                "paused": self._paused,
+                "todo": [t.to_wire() for t in self._todo],
+                "doing": [
+                    [wid, t.to_wire()] for wid, t in self._doing.values()
+                ],
+                "retry_count": sorted(self._retry_count.items()),
+                "failed_tasks": [t.to_wire() for t in self.failed_tasks],
+                "dispatch_counts": sorted(self._dispatch_counts.items()),
+                "backups": sorted(self._backups.items()),
+                "durations": {
+                    k: list(v) for k, v in sorted(self._durations.items())
+                },
+                "completed_records": self._completed_records,
+                "requeued_records": self._requeued_records,
+                "recomputed_records": self._recomputed_records,
+                "drain_flushed_records": self._drain_flushed_records,
+                "preempted_task_requeues": self._preempted_task_requeues,
+                "backups_dispatched": self._backups_dispatched,
+                "backup_wins": self._backup_wins,
+                "primary_wins": self._primary_wins,
+                "backup_promotions": self._backup_promotions,
+                "late_reports": self._late_reports,
+            }
+
+    def restore_state(self, state: dict, requeue_doing: bool = True):
+        """Adopt an exported dispatcher state (the new master's half of
+        the manifest protocol). With `requeue_doing` (the adoption
+        default) every in-flight task is put back at the head of the
+        todo queue exactly like `recover_tasks` would: the old owner
+        may still be running it, but its eventual report lands at this
+        master as unknown/stale and is dropped, while the requeued
+        copy's re-dispatch keeps the pinned spec_key — duplicate window
+        pushes are absorbed shard-side, and the retrain is charged to
+        `recomputed_records` through the surviving dispatch_counts
+        entry, so the goodput gap stays explained. `requeue_doing=False`
+        reproduces the exported state byte-identically (tests; planned
+        hand-offs where the doing-map already drained to empty)."""
+        if state.get("schema") != 1:
+            raise ValueError(
+                f"unknown dispatcher state schema: {state.get('schema')!r}"
+            )
+        with self._lock:
+            self._epoch = int(state["epoch"])
+            self._task_id = int(state["task_id"])
+            self._attempt_seq = int(state["attempt_seq"])
+            self._paused = bool(state["paused"])
+            self._todo = [Task.from_wire(d) for d in state["todo"]]
+            self._doing = {
+                Task.from_wire(d).task_id: (int(wid), Task.from_wire(d))
+                for wid, d in state["doing"]
+            }
+            self._retry_count = {
+                int(k): int(v) for k, v in state["retry_count"]
+            }
+            self.failed_tasks = [
+                Task.from_wire(d) for d in state["failed_tasks"]
+            ]
+            self._dispatch_counts = {
+                int(k): int(v) for k, v in state["dispatch_counts"]
+            }
+            self._backups = {int(k): int(v) for k, v in state["backups"]}
+            self._durations = {
+                k: list(v) for k, v in state["durations"].items()
+            }
+            self._completed_records = int(state["completed_records"])
+            self._requeued_records = int(state["requeued_records"])
+            self._recomputed_records = int(state["recomputed_records"])
+            self._drain_flushed_records = int(state["drain_flushed_records"])
+            self._preempted_task_requeues = int(
+                state["preempted_task_requeues"]
+            )
+            self._backups_dispatched = int(state["backups_dispatched"])
+            self._backup_wins = int(state["backup_wins"])
+            self._primary_wins = int(state["primary_wins"])
+            self._backup_promotions = int(state["backup_promotions"])
+            self._late_reports = int(state["late_reports"])
+            self._started = {}
+            if requeue_doing:
+                requeued = []
+                for tid in sorted(self._doing):
+                    _, task = self._doing[tid]
+                    if task.type == TaskType.TRAINING:
+                        self._requeued_records += task.end - task.start
+                    self._preempted_task_requeues += 1
+                    requeued.append(task)
+                self._doing = {}
+                # a backup copy's owner map died with the old doing-map
+                self._backups = {}
+                self._todo = requeued + self._todo
+            else:
+                # in-flight tasks keep their owners; re-arm their
+                # dispatch clocks so the speculation plane measures
+                # from adoption, not from a dead master's monotonic era
+                now = self._clock()
+                self._started = {tid: now for tid in self._doing}

@@ -284,6 +284,64 @@ class WorkerManager:
                 standby=promoted is not None or dead_standby,
             )
 
+    # -- migration plane (master/migration.py) ------------------------------
+
+    def export_state(self) -> dict:
+        """Worker-fleet section of the job manifest: everything a new
+        master needs to ADOPT this fleet without relaunching it — the
+        id high-water mark (fresh ids must stay fresh across masters or
+        the dispatcher's doing-map goes ambiguous), phases, the standby
+        and policy-stopped sets, and the budget/telemetry counters.
+        Canonical ordering (sorted pair lists for int-keyed maps) so
+        the serialized manifest is byte-stable for identical state."""
+        with self._lock:
+            return {
+                "schema": 1,
+                "next_id": self._next_id,
+                "live": self._live,
+                "relaunch": self._relaunch,
+                "relaunches": self._relaunches,
+                "promotions": self._promotions,
+                "policy_stops": self._policy_stops,
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+                "phases": [
+                    [int(wid), phase]
+                    for wid, phase in sorted(self._phases.items())
+                ],
+                "standby": sorted(self._standby),
+                "policy_stopped": sorted(self._policy_stopped),
+            }
+
+    def restore_state(self, state: dict):
+        """Adopt a running worker fleet from a job manifest. The
+        adopting manager was constructed over the SAME backend (its
+        __init__ already swapped the backend's event callback to this
+        instance — single-callback semantics make that the whole
+        hand-off) and must NOT call start_workers(): every process in
+        `phases` is already alive and will find the new master via its
+        --master_candidates failover path."""
+        if int(state.get("schema", -1)) != 1:
+            raise ValueError(
+                f"unsupported worker-manager state schema {state.get('schema')!r}"
+            )
+        with self._lock:
+            self._next_id = int(state["next_id"])
+            self._live = int(state["live"])
+            self._relaunch = bool(state["relaunch"])
+            self._relaunches = int(state["relaunches"])
+            self._promotions = int(state["promotions"])
+            self._policy_stops = int(state["policy_stops"])
+            self._scale_ups = int(state["scale_ups"])
+            self._scale_downs = int(state["scale_downs"])
+            self._phases = {
+                int(wid): phase for wid, phase in state["phases"]
+            }
+            self._standby = {int(w) for w in state["standby"]}
+            self._policy_stopped = {
+                int(w) for w in state["policy_stopped"]
+            }
+
     # -- introspection ------------------------------------------------------
 
     def snapshot(self) -> dict:

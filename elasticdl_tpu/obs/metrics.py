@@ -80,6 +80,7 @@ METRIC_REGISTRY: Dict[str, str] = {
     "edl_sched_scale_ups_total": "Autoscaler scale-up decisions executed.",
     "edl_sched_scale_downs_total": "Autoscaler scale-down decisions executed.",
     "edl_sched_preemptions_total": "Capacity tokens reclaimed by arbiter preemption.",
+    "edl_sched_migrations_total": "Jobs moved by the arbiter's migrate verdict instead of preempted.",
     # the obs plane's own health
     "edl_trace_spans": "Spans currently held in the process SpanRecorder.",
     "edl_trace_spans_dropped_total": "Spans evicted from the SpanRecorder ring.",

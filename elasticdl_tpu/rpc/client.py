@@ -57,6 +57,10 @@ class RpcClient:
                 channel = grpc.intercept_channel(channel, *interceptors)
         self._channel = channel
         self._service = service_name
+        # kept for reconnect(): a re-pointed client must rebuild its
+        # channel/transport with the SAME chaos plan and tier pin
+        self._fault_plan = plan
+        self._tier = transport
         # fast-path tier for co-located endpoints (None = plain gRPC).
         # The transport shares `plan` with the interceptors above, so
         # chaos counters advance identically whichever tier serves.
@@ -82,6 +86,53 @@ class RpcClient:
 
     def wait_ready(self, timeout: float = 30.0):
         grpc.channel_ready_future(self._channel).result(timeout=timeout)
+
+    def reconnect(self, addr: str):
+        """Re-point this client at a different endpoint IN PLACE — the
+        worker's master-failover path (worker/worker.py): every layer
+        holding this client object (task loop, PS client fan-out,
+        phase-stats reporter) keeps its reference while the channel,
+        transport tier, memoized stubs, circuit breaker and wire-stats
+        row are swapped for the new address. In-flight calls race the
+        swap harmlessly: they finish (or fail) against the old channel,
+        and a retry memoizes a fresh stub on the new one. The chaos
+        plan and tier pin from construction are reapplied, so fault
+        injection and bytes accounting survive the move."""
+        channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+        plan = self._fault_plan
+        if plan is not None:
+            interceptors = plan.client_interceptors()
+            if interceptors:
+                channel = grpc.intercept_channel(channel, *interceptors)
+        from elasticdl_tpu.rpc import transport as transport_mod
+
+        transport = transport_mod.select_transport(
+            addr, fault_plan=plan, tier=self._tier
+        )
+        # the swap is deliberately lock-free: each attribute move is a
+        # single reference assignment, and a call racing the swap
+        # harmlessly finishes (or fails and retries) on whichever
+        # object it already read — self._calls_lock guards ONLY the
+        # stub memoization dict. Swap first, clear last: a stale stub
+        # memoized mid-swap is dropped by the clear, and everything
+        # memoized after it binds the new channel.
+        old_channel = self._channel
+        old_transport = self._transport
+        self._channel = channel
+        self._transport = transport
+        self._breaker = CircuitBreaker(addr)
+        self.wire = wire_stats_for(addr)
+        with self._calls_lock:
+            self._calls = {}
+        try:
+            old_channel.close()
+        except Exception:
+            pass
+        if old_transport is not None and hasattr(old_transport, "close"):
+            try:
+                old_transport.close()
+            except Exception:
+                pass
 
     def call(
         self,

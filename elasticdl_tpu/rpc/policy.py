@@ -84,6 +84,16 @@ IDEMPOTENT_METHODS: FrozenSet[str] = frozenset(
         # obs plane: both are reads of process-local recorders
         "GetTrace",
         "GetMetrics",
+        # migration plane (master/migration.py): GetJobManifest is a
+        # pure read of the published manifest; BeginHandoff is a latch
+        # (a resend finds the dispatcher already paused); the refence
+        # RPCs are idempotent by target generation — a resend of the
+        # same bump no-ops (== current), and a stale one is rejected
+        # FAILED_PRECONDITION, which is non-retryable anyway
+        "GetJobManifest",
+        "BeginHandoff",
+        "PSRefence",
+        "KVRefence",
         # PS shard plane: reads, SETNX init, report_key-deduped pushes,
         # overwrite-semantics opt restore
         "PSInit",

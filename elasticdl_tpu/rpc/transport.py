@@ -399,6 +399,11 @@ class ServerDispatcher:
                 raise PolicyRpcError(
                     grpc.StatusCode.FAILED_PRECONDITION, _sanitized_detail(e)
                 )
+            except PolicyRpcError:
+                # a handler that classified its own status (e.g. the
+                # unadopted-standby gate answering UNAVAILABLE) keeps it —
+                # re-wrapping as INTERNAL would defeat the classification
+                raise
             except Exception as e:
                 logger.exception("RPC handler %s failed", method)
                 # carry a sanitized one-line summary so the client can tell

@@ -27,13 +27,20 @@ logger = get_logger(__name__)
 class JobHandle:
     """One registered job's view of the pool."""
 
-    def __init__(self, name: str, qos: str, preempt_cb=None):
+    def __init__(self, name: str, qos: str, preempt_cb=None, migrate_cb=None):
         self.name = name
         self.qos = qos
         self.priority = priority_of(qos)
         self.preempt_cb = preempt_cb
+        # migration plane (master/migration.py): a job that can hand
+        # itself off (planned master migration + drained workers)
+        # registers this; the arbiter then issues a `migrate` verdict
+        # before falling back to preemption — capacity is reclaimed by
+        # MOVING the job, not by killing its workers mid-window
+        self.migrate_cb = migrate_cb
         self.granted = 0  # guarded by the arbiter's lock
         self.preempted = 0
+        self.migrated = 0
 
 
 class PriorityArbiter:
@@ -45,6 +52,7 @@ class PriorityArbiter:
         self._jobs: List[JobHandle] = []
         self._grants = 0
         self._preemptions = 0
+        self._migrations = 0
         self._rejections = 0
 
     # -- registration -------------------------------------------------------
@@ -54,11 +62,17 @@ class PriorityArbiter:
         name: str,
         qos: str,
         preempt_cb: Optional[Callable[[int], int]] = None,
+        migrate_cb: Optional[Callable[[int], int]] = None,
     ) -> JobHandle:
         """`preempt_cb(n)` must release up to n workers and return how
         many it actually stopped; it must not call back into the
-        arbiter (token bookkeeping here is the caller's)."""
-        handle = JobHandle(name, qos, preempt_cb)
+        arbiter (token bookkeeping here is the caller's).
+        `migrate_cb(n)`, when given, is the PREFERRED verdict for this
+        job as a victim: it should reclaim up to n workers by draining
+        + handing the job off (master/migration.planned_handoff) and
+        return how many it freed — any shortfall falls back to
+        preempt_cb. Same reentrancy contract as preempt_cb."""
+        handle = JobHandle(name, qos, preempt_cb, migrate_cb)
         with self._lock:
             self._jobs.append(handle)
         return handle
@@ -98,29 +112,49 @@ class PriorityArbiter:
                         break
         granted = take
         for victim, k in plan:
-            reclaimed = k
-            if victim.preempt_cb is not None:
+            # verdict ladder: migrate first (the job MOVES, its workers
+            # drain at task boundaries and nothing recomputes), then
+            # preempt the shortfall (pod-kill path the recovery plane
+            # survives), then bare token clawback for callback-less jobs
+            migrated = 0
+            if victim.migrate_cb is not None:
                 try:
-                    reclaimed = int(victim.preempt_cb(k))
+                    migrated = max(0, min(int(victim.migrate_cb(k)), k))
+                except Exception:
+                    logger.warning(
+                        "migrate_cb of job %s failed", victim.name, exc_info=True
+                    )
+                    migrated = 0
+            preempted = k - migrated
+            if migrated < k and victim.preempt_cb is not None:
+                try:
+                    preempted = int(victim.preempt_cb(k - migrated))
                 except Exception:
                     logger.warning(
                         "preempt_cb of job %s failed", victim.name, exc_info=True
                     )
-                    reclaimed = 0
+                    preempted = 0
+            reclaimed = migrated + preempted
             with self._lock:
                 reclaimed = max(0, min(reclaimed, victim.granted))
+                migrated = min(migrated, reclaimed)
                 victim.granted -= reclaimed
-                victim.preempted += reclaimed
+                victim.preempted += reclaimed - migrated
+                victim.migrated += migrated
                 handle.granted += reclaimed
-                self._preemptions += reclaimed
+                self._preemptions += reclaimed - migrated
+                self._migrations += migrated
             if reclaimed:
                 logger.info(
-                    "arbiter: preempted %d worker(s) of %s (%s) for %s (%s)",
+                    "arbiter: reclaimed %d worker(s) of %s (%s) for %s (%s)"
+                    " — %d migrated, %d preempted",
                     reclaimed,
                     victim.name,
                     victim.qos,
                     handle.name,
                     handle.qos,
+                    migrated,
+                    reclaimed - migrated,
                 )
                 from elasticdl_tpu.obs import flight as obs_flight
                 from elasticdl_tpu.obs import metrics as obs_metrics
@@ -130,10 +164,16 @@ class PriorityArbiter:
                     victim=victim.name,
                     beneficiary=handle.name,
                     workers=reclaimed,
+                    migrated=migrated,
                 )
-                obs_metrics.get_registry().inc(
-                    "edl_sched_preemptions_total", reclaimed
-                )
+                if reclaimed - migrated:
+                    obs_metrics.get_registry().inc(
+                        "edl_sched_preemptions_total", reclaimed - migrated
+                    )
+                if migrated:
+                    obs_metrics.get_registry().inc(
+                        "edl_sched_migrations_total", migrated
+                    )
             granted += reclaimed
         with self._lock:
             self._grants += granted
@@ -158,6 +198,7 @@ class PriorityArbiter:
                 "free": self._capacity - held,
                 "grants": self._grants,
                 "preemptions": self._preemptions,
+                "migrations": self._migrations,
                 "rejections": self._rejections,
                 "jobs": [
                     {
@@ -165,6 +206,7 @@ class PriorityArbiter:
                         "qos": h.qos,
                         "granted": h.granted,
                         "preempted": h.preempted,
+                        "migrated": h.migrated,
                     }
                     for h in self._jobs
                 ],

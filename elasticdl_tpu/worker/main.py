@@ -33,15 +33,96 @@ logger = get_logger(__name__)
 def _is_unreachable(e: BaseException) -> bool:
     """True when an error means 'peer endpoint gone past the retry
     budget' (the shared RetryPolicy already burned its attempts before
-    this surfaced) rather than a worker-side bug."""
+    this surfaced) rather than a worker-side bug. Walks the
+    cause/context chain (same classification as
+    worker.Worker._is_master_unreachable_exc): the sync and teardown
+    layers wrap RPC errors, and a wrapped UNAVAILABLE exiting as an
+    anonymous crash would cost the job a relaunch slot."""
     import grpc
 
-    if isinstance(e, grpc.FutureTimeoutError):
-        return True
-    code = getattr(e, "code", lambda: None)()
-    return code in (
-        grpc.StatusCode.UNAVAILABLE,
-        grpc.StatusCode.DEADLINE_EXCEEDED,
+    exc, hops = e, 0
+    while exc is not None and hops < 8:
+        if isinstance(exc, grpc.FutureTimeoutError):
+            return True
+        code = getattr(exc, "code", lambda: None)()
+        if code in (
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.StatusCode.DEADLINE_EXCEEDED,
+            # a hard-stopped server (master SIGKILL cutover) tears
+            # down in-flight calls as CANCELLED, not UNAVAILABLE
+            grpc.StatusCode.CANCELLED,
+        ):
+            return True
+        exc = exc.__cause__ or exc.__context__
+        hops += 1
+    return False
+
+
+def _boot_handshake(client, primary_addr: str, candidates):
+    """First master contact, with boot-time failover.
+
+    A worker relaunched while a master cutover is in flight is handed
+    the OLD master address in argv (the relaunching manager predates
+    the adoption); without candidates it would stall the full handshake
+    timeout against a dead endpoint and burn a relaunch slot. With
+    candidates configured, fail the primary handshake fast, then probe
+    the candidate set for the highest adopted `master_generation`
+    responder — the same election rule as the in-job path
+    (worker.Worker._await_master_failover): a standby that has not
+    adopted yet answers UNAVAILABLE and is skipped, a zombie old
+    master loses the generation comparison. On success the client is
+    re-pointed IN PLACE (RpcClient.reconnect). Returns the GetPSConfig
+    snapshot the rest of boot reads shard endpoints from."""
+    try:
+        client.wait_ready(timeout=5 if candidates else 60)
+        # shard discovery: always ask the master (argv can go stale
+        # across elastic relaunches; empty lists = classic single-PS /
+        # in-master embedding store)
+        return client.call("GetPSConfig", {})
+    except Exception as e:
+        if not candidates or not _is_unreachable(e):
+            raise
+        logger.warning(
+            "master %s unreachable at boot (%s); probing %d failover "
+            "candidate(s)", primary_addr, e, len(candidates),
+        )
+    import time
+
+    import grpc
+
+    from elasticdl_tpu.rpc.client import RpcClient
+
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        best = None  # (master_generation, addr, cfg)
+        for addr in candidates:
+            probe = None
+            try:
+                probe = RpcClient(addr)
+                cfg = probe.call("GetPSConfig", {}, timeout=2.0)
+                gen = int(cfg.get("master_generation", 0) or 0)
+                if best is None or gen > best[0]:
+                    best = (gen, addr, cfg)
+            except Exception:
+                pass  # dead primary / still-gated standby: next one
+            finally:
+                if probe is not None:
+                    try:
+                        probe.close()
+                    except Exception:
+                        pass
+        if best is not None:
+            gen, addr, cfg = best
+            logger.info(
+                "boot failover: following master generation %d at %s",
+                gen, addr,
+            )
+            client.reconnect(addr)
+            return cfg
+        time.sleep(0.5)
+    # classified unreachable by the caller -> EXIT_CODE_MASTER_UNREACHABLE
+    raise grpc.FutureTimeoutError(
+        "no reachable master among candidates within the boot deadline"
     )
 
 
@@ -74,13 +155,19 @@ def main(argv=None) -> int:
         prediction_outputs_processor=args.prediction_outputs_processor,
     )
 
+    # master-failover candidates (master/migration.py): with these set,
+    # a master cutover is ridden out in-job instead of via exit-3
+    # relaunch, and the boot handshake itself fails over (parsed BEFORE
+    # the handshake — a relaunched worker's argv addr may be the dead
+    # pre-cutover master)
+    candidates = [
+        a.strip()
+        for a in getattr(args, "master_candidates", "").split(",")
+        if a.strip()
+    ] or None
     client = RpcClient(args.master_addr)
     try:
-        client.wait_ready(timeout=60)
-        # shard discovery: always ask the master (argv can go stale
-        # across elastic relaunches; empty lists = classic single-PS /
-        # in-master embedding store)
-        ps_cfg = client.call("GetPSConfig", {})
+        ps_cfg = _boot_handshake(client, args.master_addr, candidates)
     except Exception as e:
         if _is_unreachable(e):
             logger.error(
@@ -111,6 +198,7 @@ def main(argv=None) -> int:
         sync_dtype=args.sync_dtype or None,
         sync_compress=getattr(args, "sync_compress", "") or None,
         overlap_sync=getattr(args, "overlap_sync", "") or None,
+        master_candidates=candidates,
     )
     # device-level tracing (SURVEY §5.1): a jax.profiler trace of the
     # whole task loop, viewable in TensorBoard/Perfetto/XProf. The

@@ -152,9 +152,27 @@ class Worker:
         sync_dtype: Optional[str] = None,  # bf16/int8 sync plane w/ EF residual
         sync_compress: Optional[str] = None,  # "topk:<ratio>" sparsification
         overlap_sync: Optional[str] = None,  # on|off overlap plane gate
+        master_candidates=None,  # master-failover endpoints (migration.py)
     ):
         self._id = worker_id
         self._master = master
+        # Master-migration plane (master/migration.py): every endpoint a
+        # master for this job may answer at — primary first, standbys
+        # after. On a master-unreachable GetTask/ReportTaskResult the
+        # worker re-resolves IN-JOB (no process exit, no relaunch): probe
+        # candidates, follow the highest master_generation responder,
+        # reconnect the control channel in place. None = legacy behavior
+        # (exit EXIT_CODE_MASTER_UNREACHABLE for relaunch).
+        self._master_candidates = (
+            [str(a) for a in master_candidates] if master_candidates else None
+        )
+        self._master_generation = -1  # highest adopted-master gen seen
+        # serializes _await_master_failover across the task loop and
+        # the sync/pull threads: the first thread to notice the dead
+        # master probes; the rest block here and find the generation
+        # already advanced (probing again would spin — the adopted
+        # generation is not > the one the winner just recorded)
+        self._failover_lock = threading.Lock()
         # Sharded PS: the flat vector's slices live behind N endpoints
         # and pushes/pulls fan out in parallel (rpc/ps_client.ShardedPS).
         # The master stays the control plane (tasks, eval, metadata);
@@ -414,7 +432,7 @@ class Worker:
     # ------------------------------------------------------------------ RPCs
 
     def get_task(self):
-        resp = self._master.call("GetTask", {"worker_id": self._id})
+        resp = self._call_master("GetTask", {"worker_id": self._id})
         self._job_failed = resp.get("failed", False)
         self._is_standby = resp.get("standby", False)
         return Task.from_wire(resp["task"]), resp.get("finished", False)
@@ -471,6 +489,137 @@ class Worker:
         gen = gens[idx] if idx < len(gens) else -1
         self._ps.set_aggregator(eps[idx], gen)
 
+    # ------------------------------------------------- master failover
+
+    def _call_master(self, method: str, request: dict):  # edl-lint: disable=lock-order -- _failover_lock exists precisely to park losers behind the winner's candidate probe: a concurrent probe would spin its full deadline (the adopted generation is never > what the winner just recorded), so blocking contenders on the RPC is the design, and no other lock is ever taken inside
+        """Control-plane RPC with one-shot master-failover retry.
+
+        Every master call on the training path routes through here —
+        task loop (GetTask / ReportTaskResult), window sync
+        (ReportWindowMeta / ReportLocalUpdate) and model/aux pulls
+        (GetModel / GetAux): when the master stays unreachable past the
+        shared retry budget AND failover candidates are configured,
+        re-resolve the adopted master (`_await_master_failover`) and
+        retry the call ONCE on the new channel. All of these are safe
+        to resend after the ambiguous first attempt: GetTask re-leases,
+        ReportTaskResult and ReportLocalUpdate dedup on their attempt
+        keys, ReportWindowMeta is monotonic-max bookkeeping, and
+        GetModel/GetAux are reads. A mid-window master death therefore
+        rides the cutover in-job instead of killing the worker between
+        its gradient push and its meta report. Without candidates the
+        error propagates and worker/main.py exits
+        EXIT_CODE_MASTER_UNREACHABLE for relaunch, exactly as before."""
+        try:
+            return self._master.call(method, request)
+        except Exception as e:
+            if (
+                not self._master_candidates
+                or not hasattr(self._master, "reconnect")
+                or not self._is_master_unreachable_exc(e)
+            ):
+                raise
+            logger.warning(
+                "Worker %d: master unreachable on %s (%s); trying "
+                "failover candidates", self._id, method, e,
+            )
+            gen_at_failure = self._master_generation
+            with self._failover_lock:
+                # another thread may have completed the failover while
+                # we waited for the lock: the channel is already
+                # re-pointed, so just retry on it
+                if self._master_generation <= gen_at_failure:
+                    if not self._await_master_failover():
+                        raise
+            return self._master.call(method, request)
+
+    def _is_master_unreachable_exc(self, exc) -> bool:
+        """'Peer endpoint gone past the retry budget' (same
+        classification as worker/main.py:_is_unreachable), walking the
+        cause/context chain because the task loop wraps RPC errors."""
+        import grpc
+
+        e, hops = exc, 0
+        while e is not None and hops < 8:
+            if isinstance(e, grpc.FutureTimeoutError):
+                return True
+            code = getattr(e, "code", lambda: None)()
+            if code in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                # a hard-stopped server (master SIGKILL cutover) tears
+                # down in-flight calls as CANCELLED, not UNAVAILABLE
+                grpc.StatusCode.CANCELLED,
+            ):
+                return True
+            e = e.__cause__ or e.__context__
+            hops += 1
+        return False
+
+    def _await_master_failover(self, deadline: float = 60.0) -> bool:  # edl-lint: disable=thread-provenance -- _master_generation is one int followed monotonically (strictly-greater check): a stale read from a racing role costs one extra probe round, never a backward move, and both roles funnel through this same loop
+        """Re-resolve the job's master after a migration cutover.
+
+        Probes every candidate endpoint with a short-deadline
+        GetPSConfig and follows the highest `master_generation`
+        responder — a standby that has not adopted yet answers
+        UNAVAILABLE (its handlers are gated), and a zombie old master
+        loses the generation comparison, so split-brain cannot capture
+        the worker. On success the control channel is re-pointed IN
+        PLACE (RpcClient.reconnect) and the PS/KV/aggregator clients are
+        refreshed from the same config snapshot (the cutover refenced
+        every shard at gen+1; stale client epochs would be rejected
+        FAILED_PRECONDITION on the next push). Local training state is
+        NOT reset here: shard versions are unchanged by a master
+        migration, so the model this worker holds is still the true
+        trajectory — only the fencing epochs moved."""
+        if not self._master_candidates:
+            return False
+        from elasticdl_tpu.rpc.client import RpcClient
+
+        start = time.monotonic()
+        while time.monotonic() - start < deadline:
+            best = None  # (master_generation, addr, cfg)
+            for addr in self._master_candidates:
+                probe = None
+                try:
+                    probe = RpcClient(addr)
+                    cfg = probe.call("GetPSConfig", {}, timeout=2.0)
+                    gen = int(cfg.get("master_generation", 0) or 0)
+                    if best is None or gen > best[0]:
+                        best = (gen, addr, cfg)
+                except Exception:
+                    pass  # dead primary / ungated standby: next candidate
+                finally:
+                    if probe is not None:
+                        try:
+                            probe.close()
+                        except Exception:
+                            pass
+            if best is not None and best[0] > self._master_generation:
+                gen, addr, cfg = best
+                self._master.reconnect(addr)
+                self._master_generation = gen
+                eps = cfg.get("endpoints") or []
+                gens = cfg.get("ps_generations") or None
+                if self._ps is not None and eps:
+                    self._ps.update_endpoints(eps, gens)
+                    self._arm_aggregator(cfg)
+                kv_eps = cfg.get("kv_endpoints") or []
+                if self._kv is not None and kv_eps:
+                    self._kv.update_endpoints(
+                        kv_eps, cfg.get("kv_generations") or None
+                    )
+                logger.info(
+                    "Worker %d: master failover complete — following "
+                    "generation %d at %s", self._id, gen, addr,
+                )
+                return True
+            time.sleep(0.25)
+        logger.error(
+            "Worker %d: no adopted master found within %.0fs",
+            self._id, deadline,
+        )
+        return False
+
     def pull_model(self, min_version: int = -1, method: str = MethodType.MINIMUM):
         """reference: worker.py:103-124 (var assign becomes pytree swap)."""
         with obs_trace.span(
@@ -508,7 +657,7 @@ class Worker:
                 # the master (single-PS pulls return both together)
                 aux = None
                 if self._aux:
-                    aux = self._master.call("GetAux", {}).get("aux")
+                    aux = self._call_master("GetAux", {}).get("aux")
                 self._set_flat(vec, aux)
             with self._report_lock:
                 self._shard_versions = versions
@@ -534,7 +683,7 @@ class Worker:
                 req["version"] = self._version
             if use_flat:
                 req["flat"] = True
-        resp = self._master.call("GetModel", req)
+        resp = self._call_master("GetModel", req)
         if resp["version"] < 0:
             return False  # master model not initialized yet
         if use_flat and resp.get("params_flat") is not None:
@@ -683,7 +832,7 @@ class Worker:
                 meta["edl_gradient"] = edl_grads
             if loss_h is not None:
                 meta["loss"] = float(loss_h)
-            self._master.call("ReportWindowMeta", meta)
+            self._call_master("ReportWindowMeta", meta)
             with self._report_lock:
                 # elementwise max: concurrent pipelined pushes can
                 # complete out of order, and a rolled-back vector would
@@ -893,7 +1042,7 @@ class Worker:
         return meta, arrays
 
     def report_task_result(self, task_id: int, err: str = ""):
-        self._master.call(
+        self._call_master(
             "ReportTaskResult",
             {"task_id": task_id, "err_message": err, "worker_id": self._id},
         )
@@ -1711,14 +1860,14 @@ class Worker:
                     meta["edl_gradient"] = req["edl_gradient"]
                 if step_loss_h is not None:
                     meta["loss"] = float(step_loss_h)
-                meta_resp = self._master.call("ReportWindowMeta", meta)
+                meta_resp = self._call_master("ReportWindowMeta", meta)
                 resp = {"version": min(versions)}
                 if merged:
                     resp["params_flat"] = merged
                     resp["aux"] = meta_resp.get("aux")
             else:
                 versions = None
-                resp = self._master.call("ReportLocalUpdate", req)
+                resp = self._call_master("ReportLocalUpdate", req)
             with self._report_lock:
                 if epoch != self._sync_epoch:
                     return  # reset raced the RPC: discard the response
@@ -1900,7 +2049,7 @@ class Worker:
             hops += 1
         return False
 
-    def _await_shard_recovery(
+    def _await_shard_recovery(  # edl-lint: disable=lock-order -- same _failover_lock protocol as _call_master: contenders must park behind the single candidate probe rather than spin their own, and no other lock nests inside
         self, deadline: float = 120.0, reset: bool = True
     ) -> bool:
         """Ride out a PS/KV shard failover (master/recovery.py).
@@ -1935,7 +2084,23 @@ class Worker:
         while time.monotonic() - start < deadline:
             try:
                 cfg = self._master.call("GetPSConfig", {})
-            except Exception:
+            except Exception as e:
+                # the master itself may be mid-migration (the refence
+                # that bounced our push IS the cutover): re-resolve it
+                # through the candidate list. The failover already
+                # re-points the shard clients at the adopting master's
+                # generations, so count it as observed recovery and let
+                # the next poll round finish the resync.
+                if (self._master_candidates
+                        and hasattr(self._master, "reconnect")
+                        and self._is_master_unreachable_exc(e)):
+                    gen_at_failure = self._master_generation
+                    with self._failover_lock:
+                        if (
+                            self._master_generation > gen_at_failure
+                            or self._await_master_failover(deadline=5.0)
+                        ):
+                            observed = True
                 time.sleep(0.5)
                 continue
             rec = cfg.get("recovering") or {}
@@ -1962,8 +2127,27 @@ class Worker:
                     and list(kv_gens) != list(self._kv.generations or [])
                 )
             if not (observed or changed):
-                time.sleep(0.25)
-                continue
+                # master-cutover refence: a failover ride-out
+                # (_await_master_failover) can re-point these clients
+                # at the adopted generations BEFORE the fenced push
+                # that sent us here surfaces, so the advertised config
+                # never differs again from what the clients hold.
+                # Ground truth beats inference: probe the shards at the
+                # epochs the clients now carry — a versions-only pull
+                # (only_if_newer at an unreachable version) answers
+                # un-fenced iff the held epochs are current, and a
+                # genuinely dead shard refuses the connection.
+                if self._ps is not None:
+                    try:
+                        self._ps.pull(
+                            versions=[1 << 60] * self._ps.num_shards
+                        )
+                    except Exception:
+                        time.sleep(0.25)
+                        continue
+                else:
+                    time.sleep(0.25)
+                    continue
             if self._ps is not None and eps:
                 self._ps.update_endpoints(eps, gens)
                 # the tree may have been re-pointed (or relaunched)
@@ -2185,7 +2369,7 @@ class Worker:
                 )
                 aux = None
                 if want_aux:
-                    aux = self._master.call("GetAux", {}).get("aux")
+                    aux = self._call_master("GetAux", {}).get("aux")
                 versions, vec = fut.result()
                 if all(v >= 0 for v in versions) and vec is not None:
                     staged = (list(versions), min(versions), vec, aux)
@@ -2196,7 +2380,7 @@ class Worker:
                     "only_if_newer": True,
                     "flat": True,
                 }
-                resp = self._master.call("GetModel", req)
+                resp = self._call_master("GetModel", req)
                 if (
                     resp.get("version", -1) >= 0
                     and resp.get("params_flat") is not None

@@ -1,0 +1,445 @@
+"""Master migration plane (master/migration.py) conformance.
+
+The contract under test, per leg of a cutover:
+
+- job manifest: export -> canonical wire -> restore -> re-export is
+  BYTE-identical (the dispatcher/servicer state survives a master swap
+  exactly), and an unknown schema is rejected at the door;
+- split-brain fence: `PSShardGroup.refence` moves the fencing epoch
+  under the live slice — state survives (unlike a relaunch), while a
+  caller still stamping the old generation bounces with a terminal
+  FAILED_PRECONDITION classified as a shard outage;
+- standby gate + lease: a StandbyMaster answers UNAVAILABLE on every
+  method until it adopts, and adopts its cached manifest on its own
+  once the primary has been silent past the lease — with every
+  in-flight task requeued and the ownership generation bumped;
+- planned hand-off: BeginHandoff drains the dispatcher to a quiesced
+  manifest (paused, empty doing-map) that adopts with zero requeues
+  and all goodput counters intact;
+- restore helper: `restore_ps_shard` (the adoption path) seeds a
+  relaunched shard to exactly the state the RecoveryPlane's in-place
+  `_recover_ps` produces — params, version, and optimizer moments.
+"""
+
+import threading
+import time
+
+import grpc
+import jax
+import numpy as np
+import pytest
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.master.migration import (
+    MANIFEST_SCHEMA,
+    StandbyMaster,
+    attach_manifest_publisher,
+    build_job_manifest,
+    deserialize_manifest,
+    planned_handoff,
+    serialize_manifest,
+)
+from elasticdl_tpu.master.ps_group import PSShardGroup
+from elasticdl_tpu.master.recovery import RecoveryPlane, restore_ps_shard
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.rpc.client import RpcClient
+from elasticdl_tpu.rpc.fencing import is_fenced_error, is_shard_outage
+from elasticdl_tpu.rpc.policy import RetryPolicy
+from elasticdl_tpu.rpc.server import RpcServer
+from elasticdl_tpu.testing import build_job
+
+from tests.fixtures import linear_module
+
+
+def fast_policy():
+    return RetryPolicy(initial_backoff=0.01, max_backoff=0.05)
+
+
+def _wait_until(predicate, timeout=20.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _status_code(exc):
+    """First grpc status code on the exception's cause/context chain."""
+    e, hops = exc, 0
+    while e is not None and hops < 8:
+        code_fn = getattr(e, "code", None)
+        if callable(code_fn):
+            try:
+                code = code_fn()
+            except Exception:
+                code = None
+            if code is not None:
+                return code
+        e = e.__cause__ or e.__context__
+        hops += 1
+    return None
+
+
+def _build_pair(shards=None, records_per_task=2, epochs=1):
+    """A (servicer, dispatcher) master pair over the linear fixture —
+    the same wiring `StandbyMaster.build_fn` must produce."""
+    dispatcher = TaskDispatcher(
+        dict(shards or {"f": 6}), {}, {}, records_per_task, epochs
+    )
+    spec = spec_from_module(linear_module)
+    servicer, _eval, _ckpt = build_job(spec, dispatcher)
+    return servicer, dispatcher
+
+
+class _StubServicer:
+    def __init__(self, floors=None):
+        self.floors = dict(floors or {})
+
+    def shard_version_floor(self, shard_id: int) -> int:
+        return self.floors.get(int(shard_id), -1)
+
+
+# -- the job manifest ---------------------------------------------------------
+
+
+def test_manifest_round_trip_is_byte_identical():
+    """export -> serialize -> restore into a FRESH pair -> re-export
+    serializes to the same bytes: nothing the master alone knows is
+    lost or mutated by a migration (requeue_doing=False reproduces the
+    exported state exactly; the adoption default requeues on top of
+    this same state)."""
+    servicer, dispatcher = _build_pair(shards={"f1": 6, "f2": 4})
+    # put the dispatcher in a non-trivial pose: one settled task, one
+    # in flight, counters advanced
+    t1 = dispatcher.get(0)
+    t2 = dispatcher.get(1)
+    assert t1 is not None and t2 is not None
+    assert dispatcher.report(t1.task_id, True, worker_id=0)
+    servicer.set_master_generation(3)
+
+    manifest = build_job_manifest(servicer, dispatcher)
+    wire = serialize_manifest(manifest)
+    # wire-level fixpoint (tuple/list distinctions don't survive JSON,
+    # bytes are the canonical form)
+    assert serialize_manifest(deserialize_manifest(wire)) == wire
+    assert manifest["schema"] == MANIFEST_SCHEMA
+    assert manifest["dispatcher"]["doing"], "fixture must have in-flight work"
+
+    servicer2, dispatcher2 = _build_pair(shards={"f1": 6, "f2": 4})
+    restored = deserialize_manifest(wire)
+    servicer2.restore_model_state(restored["model"])
+    dispatcher2.restore_state(restored["dispatcher"], requeue_doing=False)
+    servicer2.set_master_generation(restored["master_generation"])
+
+    wire2 = serialize_manifest(build_job_manifest(servicer2, dispatcher2))
+    assert wire2 == wire
+    assert dispatcher2.completed_records() == dispatcher.completed_records()
+
+
+def test_manifest_unknown_schema_is_rejected():
+    servicer, dispatcher = _build_pair()
+    manifest = build_job_manifest(servicer, dispatcher)
+    manifest["schema"] = MANIFEST_SCHEMA + 1
+    with pytest.raises(ValueError, match="schema"):
+        deserialize_manifest(serialize_manifest(manifest))
+    sb = StandbyMaster(
+        "localhost:1", lambda: _build_pair(), lease_secs=60, manifest_secs=60
+    )
+    try:
+        with pytest.raises(ValueError, match="schema"):
+            sb.adopt(manifest)
+        assert not sb.adopted
+    finally:
+        sb.stop()
+
+
+# -- split-brain fencing ------------------------------------------------------
+
+
+def test_refence_preserves_state_and_fences_stale_generation():
+    """The cutover's fence leg: after `refence` the shard still holds
+    the model AT ITS VERSION (contrast relaunch_shard, which boots
+    empty), while traffic stamping the deposed generation is rejected
+    terminally — FAILED_PRECONDITION, classified as a shard outage, so
+    the old master's retry layer re-resolves instead of re-sending."""
+    group = PSShardGroup(1, mode="inproc", use_async=True)
+    group.start()
+    try:
+        n = 4
+        group.ensure_init(np.zeros(n, np.float32))
+        client = group.client()
+        versions, vec = client.push_grad(
+            np.full(n, 0.5, np.float32), [0], return_model=True
+        )
+        assert versions == [1]
+
+        assert group.refence() == [1]
+
+        raw = RpcClient(group.endpoints[0], policy=fast_policy())
+        try:
+            # deposed-master traffic: old epoch bounces hard
+            with pytest.raises(Exception) as ei:
+                raw.call("PSPull", {"epoch": 0}, timeout=10, idempotent=True)
+            assert is_fenced_error(ei.value), ei.value
+            assert is_shard_outage(ei.value)
+            # a stale refence (an even older master's own cutover
+            # attempt) is rejected the same way
+            with pytest.raises(Exception) as ei2:
+                raw.call("PSRefence", {"generation": 0}, timeout=10)
+            assert is_fenced_error(ei2.value), ei2.value
+            # the adopting master's epoch sees the SURVIVING state
+            resp = raw.call("PSPull", {"epoch": 1}, timeout=10,
+                            idempotent=True)
+            assert resp["version"] == 1
+            np.testing.assert_allclose(np.asarray(resp["vec"]), vec)
+        finally:
+            raw.close()
+        # the group's own fan-out client followed the bump in place
+        versions2, vec2 = group.assemble()
+        assert versions2 == [1]
+        np.testing.assert_allclose(vec2, vec)
+    finally:
+        group.stop()
+
+
+# -- standby gate + lease-expiry failover -------------------------------------
+
+
+def test_standby_gates_until_adoption_then_lease_expiry_adopts():
+    """Crash-failover leg, end to end over real endpoints: the standby
+    answers UNAVAILABLE while the primary is alive (a probing worker
+    cannot be captured), caches the continuously published manifest,
+    and once the primary goes silent past the lease adopts on its own
+    — ownership generation bumped, the dead master's in-flight task
+    requeued for recompute."""
+    servicer, dispatcher = _build_pair(shards={"f": 6}, records_per_task=2)
+    primary = RpcServer(servicer.handlers(), port=0)
+    primary.start()
+    sb = None
+    try:
+        attach_manifest_publisher(servicer, dispatcher)
+        task = dispatcher.get(0)  # dies in flight with the master
+        assert task is not None
+
+        sb = StandbyMaster(
+            f"localhost:{primary.port}",
+            lambda: _build_pair(shards={"f": 6}, records_per_task=2),
+            lease_secs=0.5,
+            manifest_secs=0.05,
+        )
+        # pre-adoption gate: GetTask is non-idempotent, so the policy
+        # refuses to retry the UNAVAILABLE — the probe fails fast
+        probe = RpcClient(sb.addr, policy=fast_policy())
+        try:
+            with pytest.raises(Exception) as ei:
+                probe.call("GetTask", {"worker_id": 0}, timeout=10)
+            assert _status_code(ei.value) == grpc.StatusCode.UNAVAILABLE
+
+            sb.start()
+            _wait_until(
+                lambda: sb.manifests_seen >= 2 and sb.cached_manifest(),
+                what="manifest cache fill",
+            )
+            assert not sb.adopted, "must not adopt while the primary lives"
+
+            primary.stop()  # SIGKILL stand-in: no drain, no goodbye
+            _wait_until(lambda: sb.adopted, what="lease-expiry adoption")
+            assert sb.adopt_reason == "lease-expired"
+
+            # ownership word moved past the dead master's
+            cfg = probe.call("GetPSConfig", {}, timeout=10, idempotent=True)
+            assert cfg["master_generation"] == 1
+            # the in-flight task was requeued with recompute charged
+            requeued = sb.dispatcher.get(7)
+            assert requeued is not None
+            assert requeued.task_id == task.task_id
+            assert (
+                sb.dispatcher.goodput_stats()["requeued_records"]
+                == task.end - task.start
+            )
+        finally:
+            probe.close()
+    finally:
+        if sb is not None:
+            sb.stop()
+        primary.stop()
+
+
+# -- planned hand-off ---------------------------------------------------------
+
+
+def test_planned_handoff_drains_then_adopts_without_requeues():
+    """The zero-recompute leg: BeginHandoff pauses the dispatcher,
+    in-flight reports keep settling, and `planned_handoff` returns only
+    the QUIESCED manifest — adoption from it requeues nothing and every
+    goodput counter crosses the cutover intact."""
+    servicer, dispatcher = _build_pair(shards={"f": 8}, records_per_task=2)
+    primary = RpcServer(servicer.handlers(), port=0)
+    primary.start()
+    sb = None
+    try:
+        attach_manifest_publisher(servicer, dispatcher)
+        task = dispatcher.get(0)
+        assert task is not None
+
+        # the worker's side of the drain: its in-flight window lands
+        # through the normal report path while the hand-off polls
+        def _finish_in_flight():
+            time.sleep(0.3)
+            dispatcher.report(task.task_id, True, worker_id=0)
+
+        reporter = threading.Thread(target=_finish_in_flight, daemon=True)
+        reporter.start()
+        manifest = planned_handoff(
+            f"localhost:{primary.port}", drain_timeout=20.0
+        )
+        reporter.join()
+        assert manifest["dispatcher"]["paused"]
+        assert not manifest["dispatcher"]["doing"]
+        assert dispatcher.get(1) is None, "drained primary stays paused"
+
+        sb = StandbyMaster(
+            f"localhost:{primary.port}",
+            lambda: _build_pair(shards={"f": 8}, records_per_task=2),
+            lease_secs=60,
+            manifest_secs=60,
+        )
+        sb.adopt_now(manifest)
+        assert sb.adopted and sb.adopt_reason == "handoff"
+        assert sb.servicer.master_generation == 1
+        stats = sb.dispatcher.goodput_stats()
+        assert stats["requeued_records"] == 0
+        assert stats["recomputed_records"] == 0
+        assert (
+            sb.dispatcher.completed_records()
+            == dispatcher.completed_records()
+        )
+        # adoption resumed the dispatcher: the fleet trains on
+        assert sb.dispatcher.get(0) is not None
+        # a second adopt is a no-op, not a double cutover
+        sb.adopt(manifest)
+        assert sb.servicer.master_generation == 1
+    finally:
+        if sb is not None:
+            sb.stop()
+        primary.stop()
+
+
+# -- the shared restore helper ------------------------------------------------
+
+
+def _assert_leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+
+
+def _pushed_group():
+    group = PSShardGroup(
+        2, mode="inproc", use_async=True,
+        optimizer_factory=linear_module.optimizer,
+    )
+    group.start()
+    n = 10
+    group.ensure_init(np.arange(n, dtype=np.float32), version=0)
+    versions, vec = group.client().push_grad(
+        np.full(n, 0.5, np.float32), [0, 0], return_model=True
+    )
+    assert versions == [1, 1]
+    return group, vec
+
+
+def _shard1_opt_leaves(group):
+    c = RpcClient(group.endpoints[1], policy=fast_policy())
+    try:
+        return c.call(
+            "PSOptState", {"epoch": group.generations[1]},
+            timeout=10, idempotent=True,
+        )["leaves"]
+    finally:
+        c.close()
+
+
+def test_restore_helper_matches_recovery_plane_restore():
+    """Regression pin for the factored-out `restore_ps_shard`: the
+    RecoveryPlane's in-place shard recovery and a migrating master's
+    direct adoption call must seed IDENTICAL shard state — params,
+    version, and optimizer moments — from the same candidate."""
+    group_a, vec_a = _pushed_group()
+    group_b, vec_b = _pushed_group()
+    try:
+        np.testing.assert_allclose(vec_a, vec_b)
+        leaves_before = _shard1_opt_leaves(group_b)
+        s, e = group_a.client().bounds[1]
+
+        # path A: the plane (kill -> worker upload -> mirror-ring opt)
+        plane = RecoveryPlane(
+            _StubServicer(floors={1: 1}),
+            ps_group=group_a,
+            restore_deadline=20.0,
+            opt_mirror_interval=0.05,
+        )
+        plane.start()
+        try:
+            _wait_until(
+                lambda: plane.opt_ring_depth(1) >= 1,
+                what="opt mirror ring fill",
+            )
+            plane.on_shard_failure("ps", 1)
+            _wait_until(
+                lambda: 1 in plane.status()["ps"], what="shard 1 fenced"
+            )
+            assert plane.offer_upload(7, 1, vec_a[s:e], 1) is True
+            _wait_until(
+                lambda: ("ps", 1, 1) in plane.recoveries(),
+                what="plane restore",
+            )
+        finally:
+            plane.stop()
+
+        # path B: adoption's direct call against a relaunched slot
+        new_ep = group_b.relaunch_shard(1)
+        assert restore_ps_shard(
+            new_ep, group_b.generations[1], vec_b[s:e], 1,
+            fence_version=1, opt_leaves=leaves_before,
+        ) is True
+
+        # both callers: same generations, same versions, same model
+        assert group_a.generations == group_b.generations == [0, 1]
+        versions_a, out_a = group_a.assemble()
+        versions_b, out_b = group_b.assemble()
+        assert versions_a == versions_b == [1, 1]
+        np.testing.assert_allclose(out_a, vec_a)
+        np.testing.assert_allclose(out_b, vec_a)
+        # ... and the same optimizer moments (plane: mirror ring;
+        # direct: the caller-supplied leaves — both snapshots of the
+        # same post-push state)
+        _assert_leaves_equal(
+            _shard1_opt_leaves(group_a), _shard1_opt_leaves(group_b)
+        )
+        _assert_leaves_equal(_shard1_opt_leaves(group_b), leaves_before)
+    finally:
+        group_a.stop()
+        group_b.stop()
+
+
+def test_restore_helper_reports_inexact_below_floor():
+    """A candidate short of the fence floor still seeds the shard
+    (best-available resume) but the helper answers False so BOTH
+    callers log/propagate the same exactness verdict."""
+    group = PSShardGroup(1, mode="inproc", use_async=True)
+    group.start()
+    try:
+        group.ensure_init(np.zeros(4, np.float32), version=0)
+        new_ep = group.relaunch_shard(0)
+        assert restore_ps_shard(
+            new_ep, group.generations[0],
+            np.ones(4, np.float32), 2, fence_version=5,
+        ) is False
+        versions, vec = group.assemble()
+        assert versions == [2]
+        np.testing.assert_allclose(vec, np.ones(4, np.float32))
+    finally:
+        group.stop()

@@ -44,6 +44,8 @@ def test_packaged_traces_all_parse():
         "flash-crowd",
         "bimodal-stragglers",
         "rolling-node-failure",
+        "master-failover-drain",
+        "master-failover-sigkill",
     }
     for name in names:
         trace = load_trace(name)
@@ -124,6 +126,24 @@ def test_invalid_json_file_is_loud(tmp_path):
                          "host": 0}]},
             "out of range",
         ),
+        (
+            {"jobs": [{"tag": "main", "records": 1024, "num_ps": 1,
+                       "master_standby": True}],
+             "events": [{"action": "kill_master", "at_progress": 0.5,
+                         "mode": "vaporize"}]},
+            "mode 'sigkill' or 'handoff'",
+        ),
+        (
+            {"jobs": [{"tag": "main", "records": 1024, "num_ps": 1}],
+             "events": [{"action": "kill_master", "at_progress": 0.5,
+                         "mode": "sigkill"}]},
+            "must declare master_standby",
+        ),
+        (
+            {"jobs": [{"tag": "main", "records": 1024,
+                       "master_standby": True}]},
+            "master_standby requires num_ps",
+        ),
         ({"expect": {"min_unicorns": 1}}, "unknown keys"),
         (
             {"chaos": {"faults": [{"kind": "meteor"}]}},
@@ -139,6 +159,28 @@ def test_invalid_json_file_is_loud(tmp_path):
 def test_malformed_traces_raise(mutation, message):
     with pytest.raises(TraceError, match=message):
         parse_trace(_trace(**mutation))
+
+
+def test_kill_master_trace_parses_and_caps_at_one_per_job():
+    raw = _trace(
+        jobs=[{"tag": "main", "records": 1024, "num_ps": 1,
+               "master_standby": True}],
+        events=[{"action": "kill_master", "at_progress": 0.5,
+                 "mode": "handoff"}],
+        gap_explained_tolerance=0.01,
+    )
+    trace = parse_trace(raw)
+    assert trace.jobs[0].master_standby
+    assert trace.events[0].mode == "handoff"
+    assert trace.gap_explained_tolerance == 0.01
+    # a second kill has no standby left waiting to adopt
+    raw["events"].append(
+        {"action": "kill_master", "at_progress": 0.8, "mode": "sigkill"}
+    )
+    with pytest.raises(TraceError, match="at most one per job"):
+        parse_trace(raw)
+    # tolerance is optional and defaults to None (no assertion armed)
+    assert parse_trace(_trace()).gap_explained_tolerance is None
 
 
 def test_deferred_job_needs_exactly_one_spawn():
@@ -274,11 +316,21 @@ def test_goodput_drain_flush_never_subtracts():
     assert with_drain["drain_flushed_records"] == 512
 
 
-def test_goodput_counter_corruption_is_loud():
-    with pytest.raises(ValueError, match="counter corruption"):
-        compute_goodput(
-            {"completed_records": 10, "recomputed_records": 11}, 1.0
-        )
+def test_goodput_recompute_exceeding_completed_clamps_at_zero():
+    # recompute is charged per PRIOR dispatch at success, so a job
+    # whose tasks averaged >= 2 failed dispatches each (worker-death
+    # requeue + master-cutover requeue_doing) legitimately recomputes
+    # more records than it has — useful throughput floors at zero
+    # while the UNCLAMPED gap keeps the recompute identity exact
+    g = compute_goodput(
+        {"completed_records": 10, "recomputed_records": 15}, 1.0
+    )
+    assert g["goodput_images_per_sec"] == 0.0
+    assert g["goodput_fraction"] == 0.0
+    assert g["raw_images_per_sec"] == pytest.approx(10.0)
+    assert g["gap_images_per_sec"] == pytest.approx(15.0)
+    assert g["gap_from_recompute_images_per_sec"] == pytest.approx(15.0)
+    assert g["gap_explained"] == pytest.approx(1.0)
 
 
 # -- dispatcher accounting ----------------------------------------------------
