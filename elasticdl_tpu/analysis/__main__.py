@@ -9,7 +9,10 @@ workflow command (``::error file=...,line=...::message``) so the CI
 analysis job surfaces findings as inline PR annotations, followed by
 the usual text summary. ``--list-rules`` prints the registered rule
 families with their one-line descriptions and exits — CI and docs
-reference this instead of hardcoding the set.
+reference this instead of hardcoding the set. ``--stats`` appends a
+per-family table of finding/suppression/baseline counts (all selected
+families, including zero rows) — CI emits it so family drift shows up
+in PR logs.
 """
 
 from __future__ import annotations
@@ -24,7 +27,7 @@ from elasticdl_tpu.analysis.core import (
     apply_baseline,
     load_baseline,
     rule_descriptions,
-    run_analysis,
+    run_analysis_detailed,
     save_baseline,
 )
 
@@ -32,6 +35,31 @@ _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json"
 )
+
+
+def _family_stats(selected, findings, new, suppressed):
+    """{family: {new, suppressed, baselined}} over the selected
+    families plus the always-on core 'lint' family, zero rows
+    included — a family silently dropping to zero IS the signal."""
+
+    def by_family(items):
+        out = {}
+        for f in items:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    total = by_family(findings)
+    new_counts = by_family(new)
+    sup_counts = by_family(suppressed)
+    rows = {}
+    for fam in ["lint"] + list(selected):
+        n_new = new_counts.get(fam, 0)
+        rows[fam] = {
+            "new": n_new,
+            "suppressed": sup_counts.get(fam, 0),
+            "baselined": total.get(fam, 0) - n_new,
+        }
+    return rows
 
 
 def main(argv=None) -> int:
@@ -76,6 +104,12 @@ def main(argv=None) -> int:
         help="also fail on stale baseline entries (fixed findings that "
         "should be removed from the baseline)",
     )
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="print per-family finding/suppression/baseline counts "
+        "after the findings (text/github formats; always included "
+        "under --format json)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -87,7 +121,7 @@ def main(argv=None) -> int:
         print(f"error: --root {args.root} is not a directory", file=sys.stderr)
         return 2
 
-    findings = run_analysis(args.root, rules=args.rule)
+    findings, suppressed = run_analysis_detailed(args.root, rules=args.rule)
 
     if args.write_baseline:
         save_baseline(args.baseline, findings)
@@ -101,6 +135,9 @@ def main(argv=None) -> int:
         baseline = load_baseline(args.baseline)
     new, stale = apply_baseline(findings, baseline)
 
+    selected = list(args.rule) if args.rule else list(RULE_FAMILIES)
+    stats = _family_stats(selected, findings, new, suppressed)
+
     if args.format == "json":
         print(
             json.dumps(
@@ -108,6 +145,7 @@ def main(argv=None) -> int:
                     "new": [f.to_dict() for f in new],
                     "baselined": len(findings) - len(new),
                     "stale_baseline_keys": stale,
+                    "stats": stats,
                 },
                 indent=2,
             )
@@ -138,6 +176,14 @@ def main(argv=None) -> int:
         if stale:
             summary += f", {len(stale)} stale baseline entr(y/ies)"
         print(summary)
+        if args.stats:
+            print("per-family counts (new / suppressed / baselined):")
+            for fam, row in stats.items():
+                print(
+                    f"  {fam:22s} {row['new']:3d} new  "
+                    f"{row['suppressed']:3d} suppressed  "
+                    f"{row['baselined']:3d} baselined"
+                )
 
     if new:
         return 1

@@ -23,6 +23,8 @@ Rule families (one module each):
 - ``async-discipline``     (async_discipline.py, interprocedural)
 - ``thread-provenance``    (thread_provenance.py, interprocedural)
 - ``exactness-lineage``    (exactness_lineage.py, interprocedural)
+- ``resource-lifecycle``   (resource_lifecycle.py, interprocedural)
+- ``shutdown-order``       (shutdown_order.py, interprocedural)
 
 The interprocedural families are the edl-verify layer: they run on the repo-wide
 call graph built by analysis/callgraph.py instead of one file at a
@@ -68,6 +70,8 @@ RULE_FAMILIES = (
     "async-discipline",
     "thread-provenance",
     "exactness-lineage",
+    "resource-lifecycle",
+    "shutdown-order",
 )
 
 #: internal families emitted by the core itself (always on, never
@@ -83,6 +87,8 @@ VERIFY_FAMILIES = (
     "async-discipline",
     "thread-provenance",
     "exactness-lineage",
+    "resource-lifecycle",
+    "shutdown-order",
 )
 
 
@@ -98,6 +104,12 @@ class Finding:
     #: part of the baseline key — role inference may sharpen without
     #: invalidating accepted entries.
     roles: Tuple[str, ...] = ()
+    #: interprocedural escape/release chain behind the finding
+    #: (resource-lifecycle / shutdown-order), e.g. ("UdsTransport.call",
+    #: "UdsTransport._checkin", "self._pool"); empty for families with
+    #: no flow model. NOT part of the baseline key — chain inference
+    #: may sharpen without invalidating accepted entries.
+    chain: Tuple[str, ...] = ()
 
     @property
     def key(self) -> str:
@@ -309,7 +321,9 @@ def _rule_modules():
         lock_discipline,
         lock_order,
         metric_registry,
+        resource_lifecycle,
         rpc_conformance,
+        shutdown_order,
         thread_provenance,
     )
 
@@ -325,6 +339,8 @@ def _rule_modules():
         "async-discipline": async_discipline,
         "thread-provenance": thread_provenance,
         "exactness-lineage": exactness_lineage,
+        "resource-lifecycle": resource_lifecycle,
+        "shutdown-order": shutdown_order,
     }
 
 
@@ -342,12 +358,14 @@ def rule_descriptions() -> Dict[str, str]:
     return out
 
 
-def run_analysis(
+def run_analysis_detailed(
     root: str, rules: Optional[Sequence[str]] = None
-) -> List[Finding]:
-    """Run the selected rule families over `root`; returns the
-    UNSUPPRESSED findings (suppression comments already applied),
-    sorted by (path, line, rule)."""
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run the selected rule families over `root`; returns
+    (unsuppressed findings, findings dropped by suppression comments),
+    each sorted by (path, line, rule). The suppressed list feeds
+    ``--stats`` — family drift is invisible if suppressions vanish
+    silently."""
     ctx = load_context(root)
     selected = list(rules) if rules else list(RULE_FAMILIES)
     unknown = [r for r in selected if r not in RULE_FAMILIES]
@@ -359,7 +377,8 @@ def run_analysis(
     runners = _rule_runners()
     for name in selected:
         findings.extend(runners[name](ctx))
-    kept = []
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
     for fi in findings:
         sf = ctx.files.get(fi.path)
         if (
@@ -367,10 +386,22 @@ def run_analysis(
             and fi.rule in RULE_FAMILIES
             and sf.suppressions.covers(fi.rule, fi.line)
         ):
+            suppressed.append(fi)
             continue
         kept.append(fi)
-    kept.sort(key=lambda fi: (fi.path, fi.line, fi.rule, fi.check, fi.message))
-    return kept
+    order = lambda fi: (fi.path, fi.line, fi.rule, fi.check, fi.message)
+    kept.sort(key=order)
+    suppressed.sort(key=order)
+    return kept, suppressed
+
+
+def run_analysis(
+    root: str, rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run the selected rule families over `root`; returns the
+    UNSUPPRESSED findings (suppression comments already applied),
+    sorted by (path, line, rule)."""
+    return run_analysis_detailed(root, rules)[0]
 
 
 # -- baseline ----------------------------------------------------------------

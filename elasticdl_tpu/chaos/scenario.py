@@ -596,8 +596,35 @@ class JobRun:
         self.migration: Optional[dict] = None
         self._killed_server = None  # stopped in kill_master, skip in stop()
         self._data_dir = ""
+        # boot products, set by start(); pre-initialized so stop() can
+        # run against a PARTIAL boot (a raise mid-start must tear down
+        # whatever already exists instead of stranding the fleet)
+        self.dispatcher = None
+        self.servicer = None
+        self.server = None
+        self.backend = None
+        self.manager = None
 
     def start(self) -> None:
+        try:
+            self._start_inner()
+        except Exception:
+            # a raise between the server boot and start_workers (bad
+            # spec args, standby bind failure, shard spawn failure)
+            # leaves a half-booted job the runner never records in
+            # _jobs — its finally sweep would miss it, leaking the RPC
+            # server and any already-spawned worker Popens; stop() is
+            # None-guarded for exactly this path
+            try:
+                self.stop()
+            except Exception:
+                logger.warning(
+                    "scenario job %s: cleanup after failed boot also "
+                    "failed", self.spec.tag, exc_info=True,
+                )
+            raise
+
+    def _start_inner(self) -> None:
         from elasticdl_tpu.cluster.pod_backend import ProcessBackend
         from elasticdl_tpu.common.args import (
             master_parser,
@@ -1047,16 +1074,19 @@ class JobRun:
             )
         if self._recovery is not None:
             self._recovery.stop()
-        self.manager.stop_relaunch_and_remove_workers()
-        self.backend.stop()
+        if self.manager is not None:
+            self.manager.stop_relaunch_and_remove_workers()
+        if self.backend is not None:
+            self.backend.stop()
         # shard tiers in main.py's teardown order (agg, ps, kv),
         # best-effort each: a failed scenario must not leak orphan
         # shard processes holding the parent's stdio pipes open
-        for group in (
+        shard_groups = () if self.servicer is None else (
             self.servicer.agg_group,
             self.servicer.ps_group,
             self.servicer.kv_group,
-        ):
+        )
+        for group in shard_groups:
             if group is not None:
                 try:
                     group.stop()
@@ -1066,7 +1096,7 @@ class JobRun:
                         self.spec.tag,
                         exc_info=True,
                     )
-        if self.server is not self._killed_server:
+        if self.server is not None and self.server is not self._killed_server:
             self.server.stop()
 
 
@@ -1257,9 +1287,27 @@ class ScenarioRunner:
             )
             raise
         finally:
-            for run in self._jobs.values():
-                run.stop()
+            self._stop_all()
         return report
+
+    def _stop_all(self) -> None:
+        """Stop every booted job, isolating per-job failures: on the
+        assert-failure exit this runs as the finally sweep, and one
+        job's raising stop() must not strand the Popen fleets of the
+        jobs after it in the dict. The first error still propagates —
+        a broken teardown is itself a scenario failure."""
+        first_error: Optional[BaseException] = None
+        for tag, run in list(self._jobs.items()):
+            try:
+                run.stop()
+            except Exception as e:
+                logger.warning(
+                    "scenario: stopping job %s failed", tag, exc_info=True
+                )
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
 
     def _drive(self, baseline_ips: Optional[float]) -> dict:
         trace, sched = self.trace, self.sched

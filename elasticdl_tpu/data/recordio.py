@@ -152,11 +152,17 @@ class RecordIOReader:
         self._path = path
         self._offsets, self._sizes = build_index(path)
         self._f = open(path, "rb")
-        self._mm = (
-            mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
-            if os.path.getsize(path)
-            else None
-        )
+        try:
+            self._mm = (
+                mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+                if os.path.getsize(path)
+                else None
+            )
+        except (OSError, ValueError):
+            # mmap of a concurrently-truncated file raises; the caller
+            # gets no reader to close(), so release the fd here
+            self._f.close()
+            raise
 
     def __len__(self) -> int:
         return len(self._offsets)

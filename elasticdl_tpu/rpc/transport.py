@@ -544,8 +544,14 @@ class UdsServer:
         except FileNotFoundError:
             pass
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(self.path)
-        self._sock.listen(128)
+        try:
+            self._sock.bind(self.path)
+            self._sock.listen(128)
+        except OSError:
+            # a half-built listener has no owner to close() it: the
+            # caller never gets the object, so release the fd here
+            self._sock.close()
+            raise
         self._dispatcher = dispatcher
         self._closed = False
         self._thread: Optional[threading.Thread] = None
@@ -646,12 +652,17 @@ class AsyncUdsServer:
             os.unlink(self.path)
         except FileNotFoundError:
             pass
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(self.path)
-        self._sock.listen(128)
-        self._sock.setblocking(False)
         self._dispatcher = dispatcher
         self._core = core if core is not None else dispatch_mod.get_loop_core()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            self._sock.bind(self.path)
+            self._sock.listen(128)
+            self._sock.setblocking(False)
+        except OSError:
+            # a half-built listener has no owner to close() it
+            self._sock.close()
+            raise
         self._server = None
         # live connection writers, severed on close(): a stopped server
         # must refuse pooled clients exactly like a stopped gRPC server
@@ -710,6 +721,13 @@ class AsyncUdsServer:
             self._core.submit(self._close_async()).result(timeout=5)
         except Exception:  # pragma: no cover - loop already gone
             pass
+        # asyncio owns the fd once start() ran (_server.close() closes
+        # it); socket.close() is idempotent, so this also releases the
+        # constructed-but-never-started and loop-already-dead paths
+        try:
+            self._sock.close()
+        except OSError:  # pragma: no cover
+            pass
         try:
             os.unlink(self.path)
         except OSError:
@@ -762,6 +780,18 @@ class UdsTransport:
                 self._pool.append(conn)
                 return
         conn.close()
+
+    def close(self):
+        """Drain the connection pool. RpcClient.close()/reconnect()
+        call this through the hasattr('close') transport hook, so a
+        worker dropping its client (or re-resolving after a master
+        migration) no longer strands up to 8 pooled UDS fds until GC."""
+        with self._pool_lock:
+            while self._pool:
+                try:
+                    self._pool.pop().close()
+                except OSError:  # pragma: no cover - already severed
+                    pass
 
     def call(self, method: str, payload: bytes, timeout: float) -> bytes:
         after = transport_faults_before(self._plan, method, "client")
@@ -1037,31 +1067,50 @@ class ShmServer:
         except FileNotFoundError:
             pass
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(self.doorbell)
-        self._sock.listen(128)
-        self.broadcaster = ShmBroadcaster(self._prefix + "x")
-        self._conn_seq = 0
-        self._thread: Optional[threading.Thread] = None
-        # live connections, severed on close(): a stopped server must
-        # refuse pooled clients exactly like a stopped gRPC server
-        self._conns: set = set()
-        self._conn_threads: list = []
-        self._conns_lock = threading.Lock()
-        self._closed = False
-        tmp = self.path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(
-                {
-                    "scope": self._scope,
-                    "generation": self.generation,
-                    "prefix": self._prefix,
-                    "doorbell": self.doorbell,
-                    "ring": self._ring,
-                    "pid": os.getpid(),
-                },
-                f,
-            )
-        os.replace(tmp, self.path)
+        try:
+            self._sock.bind(self.doorbell)
+            self._sock.listen(128)
+            self.broadcaster = ShmBroadcaster(self._prefix + "x")
+            self._conn_seq = 0
+            self._thread: Optional[threading.Thread] = None
+            # live connections, severed on close(): a stopped server
+            # must refuse pooled clients exactly like a stopped gRPC
+            # server
+            self._conns: set = set()
+            self._conn_threads: list = []
+            self._conns_lock = threading.Lock()
+            self._closed = False
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(
+                    {
+                        "scope": self._scope,
+                        "generation": self.generation,
+                        "prefix": self._prefix,
+                        "doorbell": self.doorbell,
+                        "ring": self._ring,
+                        "pid": os.getpid(),
+                    },
+                    f,
+                )
+            os.replace(tmp, self.path)
+        except Exception:
+            # a raise between the doorbell bind and the rendezvous
+            # write (disk full, unlinkable path, broadcast segment
+            # collision) leaves a half-built server the caller cannot
+            # close(): release the doorbell socket/path and the
+            # broadcast segment before re-raising so a relaunch on the
+            # same port starts clean instead of inheriting our debris
+            self._sock.close()
+            broadcaster = getattr(self, "broadcaster", None)
+            if broadcaster is not None:
+                broadcaster.close()
+            for leftover in (self.doorbell, self.path + ".tmp"):
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass
+            raise
 
     def _reclaim_stale(self) -> None:
         """Sweep a dead predecessor's rings. The rendezvous file keyed
@@ -1133,6 +1182,13 @@ class ShmServer:
                 target=self._serve_conn, args=(conn,), daemon=True
             )
             with self._conns_lock:
+                # reap finished conn threads so a long-lived server
+                # under connection churn doesn't grow the list (and
+                # close()'s join sweep) without bound
+                for dead in [
+                    x for x in self._conn_threads if not x.is_alive()
+                ]:
+                    self._conn_threads.remove(dead)
                 self._conn_threads.append(t)
             t.start()
 

@@ -1,0 +1,69 @@
+"""resource-lifecycle fixture: one violation per check. Loaded as
+source by tests/test_static_analysis.py; never imported.
+
+Each function/class trips exactly one resource-lifecycle check and is
+deliberately clean under every OTHER rule family (the CLI isolation
+test runs all families over this file): the bare acquire targets a
+parameter lock (invisible to lock-discipline), threads touch no shared
+attributes (thread-provenance-silent), and no teardown closes anything
+before a join (shutdown-order-silent).
+"""
+
+import socket
+import threading
+
+from multiprocessing.shared_memory import SharedMemory
+
+
+def publish(payload):
+    return len(payload)
+
+
+def _drain(records):
+    total = 0
+    for rec in records:
+        total += len(rec)
+    return total
+
+
+def leaks_segment_on_raise(name, payload):
+    seg = SharedMemory(name=name, create=True, size=64)
+    publish(payload)  # can raise: nothing releases seg
+    seg.close()
+    seg.unlink()
+
+
+def never_released(host):
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    conn.connect(host)
+
+
+def fire_and_forget(records):
+    t = threading.Thread(target=_drain, args=(records,))
+    t.start()  # non-daemon, never joined, never handed off
+
+
+def tally(lock, counts, key):
+    lock.acquire()  # no try/finally: a raise parks every waiter
+    counts[key] = counts.get(key, 0) + 1
+    lock.release()
+
+
+class PoolOwner:
+    """Pools sockets through a helper, but close() never drains the
+    pool — the interprocedural escape chain is
+    (PoolOwner.lend, PoolOwner._checkin, self._pool)."""
+
+    def __init__(self):
+        self._pool = []
+        self._done = False
+
+    def _checkin(self, conn):
+        self._pool.append(conn)
+
+    def lend(self, host):
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._checkin(conn)
+
+    def close(self):
+        self._done = True

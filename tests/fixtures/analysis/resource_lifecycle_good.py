@@ -1,0 +1,111 @@
+"""resource-lifecycle fixture: the clean mirror of every check in
+resource_lifecycle_bad.py. Loaded as source by
+tests/test_static_analysis.py; never imported.
+
+Exception-safe release shapes the analyzer must recognize: try/finally
+around the risky window, release-and-re-raise handlers, closing(),
+ownership transfer by return, daemon threads, local joins, close-like
+drains of pooled escapes (the while/pop idiom), and a started attr
+thread joined by the class teardown.
+"""
+
+import socket
+import threading
+
+from contextlib import closing
+from multiprocessing.shared_memory import SharedMemory
+
+
+def publish(payload):
+    return len(payload)
+
+
+def _drain(records):
+    total = 0
+    for rec in records:
+        total += len(rec)
+    return total
+
+
+def guarded_segment(name, payload):
+    seg = SharedMemory(name=name, create=True, size=64)
+    try:
+        publish(payload)
+    finally:
+        seg.close()
+
+
+def make_conn(host):
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        conn.connect(host)
+    except OSError:
+        conn.close()
+        raise
+    return conn
+
+
+def with_closing(host, payload):
+    with closing(make_conn(host)) as conn:
+        conn.sendall(payload)
+
+
+def background_tick(records):
+    t = threading.Thread(target=_drain, args=(records,), daemon=True)
+    t.start()
+
+
+def run_briefly(records):
+    t = threading.Thread(target=_drain, args=(records,))
+    t.start()
+    t.join()
+
+
+def tally(lock, counts, key):
+    lock.acquire()
+    try:
+        counts[key] = counts.get(key, 0) + 1
+    finally:
+        lock.release()
+
+
+class DrainedPool:
+    """Pools sockets through a helper AND drains the pool in close()."""
+
+    def __init__(self):
+        self._pool = []
+        self._lock = threading.Lock()
+
+    def _checkin(self, conn):
+        with self._lock:
+            if len(self._pool) < 4:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def lend(self, host):
+        conn = make_conn(host)
+        self._checkin(conn)
+
+    def close(self):
+        with self._lock:
+            while self._pool:
+                self._pool.pop().close()
+
+
+class JoinedWorker:
+    """Non-daemon attr thread, joined by the close-like teardown."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop)
+
+    def start(self):
+        self._t.start()
+
+    def _loop(self):
+        self._stop.wait(0.01)
+
+    def stop(self):
+        self._stop.set()
+        self._t.join()

@@ -1,0 +1,72 @@
+"""shutdown-order fixture: one violation per check. Loaded as source
+by tests/test_static_analysis.py; never imported.
+
+The join-under-lock case uses MANUAL acquire/release (with a proper
+try/finally, so acquire-without-finally stays silent) — exactly the
+hand-rolled teardown locking that lock-discipline's with-only held
+tracking cannot see; shutdown-order's own walk must catch it. All
+threads are daemon (resource-lifecycle-silent) and every transport
+attribute is written only in __init__ (thread-provenance-silent).
+"""
+
+import socket
+import threading
+
+from multiprocessing.shared_memory import SharedMemory
+
+
+class JoinsUnderLock:
+    """stop() joins the worker while manually holding the lock the
+    worker's loop needs — target blocks on the lock, join blocks on
+    the target."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._n = 0
+
+    def start(self):
+        self._t.start()
+
+    def _loop(self):
+        with self._lock:
+            self._n += 1
+
+    def stop(self):
+        self._lock.acquire()
+        try:
+            self._t.join()
+        finally:
+            self._lock.release()
+
+
+class ClosesBeforeDrain:
+    """close() severs the transport its pump thread still WRITES to —
+    not the wake-a-blocked-reader idiom, since sendall is not a
+    blocking read."""
+
+    def __init__(self):
+        self._conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+
+    def start(self):
+        self._pump.start()
+
+    def _pump_loop(self):
+        self._conn.sendall(b"tick")
+
+    def close(self):
+        self._conn.close()
+        self._pump.join()
+
+
+class UnguardedUnlink:
+    """The second close a SIGKILL replay guarantees raises
+    FileNotFoundError from unlink and aborts the teardown."""
+
+    def __init__(self, name):
+        self._seg = SharedMemory(name=name, create=True, size=64)
+
+    def close(self):
+        self._seg.close()
+        self._seg.unlink()

@@ -1,0 +1,94 @@
+"""shutdown-order fixture: the clean mirror of every check in
+shutdown_order_bad.py. Loaded as source by
+tests/test_static_analysis.py; never imported.
+
+Includes the wake-the-reader idiom (close-before-join is CORRECT when
+the thread is parked in a blocking read — the ShmServer/UdsServer
+accept loops do this deliberately) to pin the exemption, plus the
+guarded-unlink shapes (idempotency early-return, try/except) that
+double-close-unsafe must accept.
+"""
+
+import socket
+import threading
+
+from multiprocessing.shared_memory import SharedMemory
+
+
+class JoinsOutsideLock:
+    """Join first, lock-free; the lock only guards the counter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._n = 0
+
+    def start(self):
+        self._t.start()
+
+    def _loop(self):
+        with self._lock:
+            self._n += 1
+
+    def stop(self):
+        self._t.join()
+        with self._lock:
+            self._n = 0
+
+
+class DrainsBeforeClose:
+    """Join the writer thread, THEN sever its transport."""
+
+    def __init__(self):
+        self._conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+
+    def start(self):
+        self._pump.start()
+
+    def _pump_loop(self):
+        self._conn.sendall(b"tick")
+
+    def close(self):
+        self._pump.join()
+        self._conn.close()
+
+
+class WakesTheReader:
+    """Close-before-join is the correct order here: the thread is
+    parked in a blocking accept, and closing the socket is the wakeup
+    (the accept-loop idiom)."""
+
+    def __init__(self):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._accept = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+
+    def start(self):
+        self._accept.start()
+
+    def _accept_loop(self):
+        self._sock.accept()
+
+    def close(self):
+        self._sock.close()
+        self._accept.join()
+
+
+class GuardedUnlink:
+    """Idempotent close: early-return flag plus a guarded unlink."""
+
+    def __init__(self, name):
+        self._seg = SharedMemory(name=name, create=True, size=64)
+        self._closed = False
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._seg.close()
+        try:
+            self._seg.unlink()
+        except FileNotFoundError:
+            pass

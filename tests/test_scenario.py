@@ -11,6 +11,9 @@ import json
 import pytest
 
 from elasticdl_tpu.chaos.scenario import (
+    JobRun,
+    JobSpec,
+    ScenarioRunner,
     ScenarioScheduler,
     TraceError,
     compute_goodput,
@@ -412,6 +415,84 @@ def test_double_fault_on_same_task_charges_both_retrains():
     g = d.goodput_stats()
     assert g["requeued_records"] == 32  # two requeues of 16
     assert g["recomputed_records"] == 32  # two wasted dispatches
+
+
+# -- teardown lifecycle (regressions) -----------------------------------------
+
+
+class _StubRun:
+    """Stands in for a booted JobRun in runner._jobs."""
+
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.stopped = False
+
+    def stop(self):
+        self.stopped = True
+        if self.fail:
+            raise RuntimeError("teardown broke")
+
+
+def test_stop_all_isolates_per_job_failures(tmp_path):
+    # regression: the finally sweep used to call stop() in a plain
+    # loop — job A's raising stop() stranded the Popen fleets of every
+    # job after it in the dict. All jobs must be stopped, and the
+    # first error must still propagate (a broken teardown is itself a
+    # scenario failure).
+    runner = ScenarioRunner(
+        parse_trace(_trace()), run_dir=str(tmp_path)
+    )
+    a, b, c = _StubRun(fail=True), _StubRun(), _StubRun(fail=True)
+    runner._jobs = {"a": a, "b": b, "c": c}
+    with pytest.raises(RuntimeError, match="teardown broke"):
+        runner._stop_all()
+    assert a.stopped and b.stopped and c.stopped
+
+
+class _StubStoppable:
+    def __init__(self):
+        self.stopped = False
+
+    def stop(self):
+        self.stopped = True
+
+
+def test_jobrun_failed_boot_tears_down_partial_state(tmp_path):
+    # regression: a raise mid-_start_inner (bad spec args, shard spawn
+    # failure) left a half-booted job the runner never records in
+    # _jobs — the finally sweep missed it and the RPC server plus any
+    # already-spawned worker Popens leaked past the process exit
+    run = JobRun(
+        JobSpec(tag="t", records=64),
+        run_dir=str(tmp_path),
+        cache_dir=str(tmp_path),
+        worker_env={},
+    )
+    server = _StubStoppable()
+    backend = _StubStoppable()
+
+    def boots_then_raises():
+        run.server = server
+        run.backend = backend
+        raise RuntimeError("shard spawn failed")
+
+    run._start_inner = boots_then_raises
+    with pytest.raises(RuntimeError, match="shard spawn failed"):
+        run.start()
+    assert server.stopped and backend.stopped
+
+
+def test_jobrun_stop_is_safe_on_unbooted_run(tmp_path):
+    # stop() against a run whose _start_inner never got anywhere must
+    # be a no-op, not an AttributeError — start()'s cleanup path and
+    # the runner sweep both rely on it
+    run = JobRun(
+        JobSpec(tag="t", records=64),
+        run_dir=str(tmp_path),
+        cache_dir=str(tmp_path),
+        worker_env={},
+    )
+    run.stop()
 
 
 # -- e2e: one real scenario replay -------------------------------------------

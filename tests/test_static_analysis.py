@@ -22,6 +22,7 @@ from elasticdl_tpu.analysis import abort_discipline as ad
 from elasticdl_tpu.analysis import callgraph as cg
 from elasticdl_tpu.analysis import fencing_conformance as fc
 from elasticdl_tpu.analysis import lock_order as lo
+from elasticdl_tpu.analysis import resource_lifecycle as rl
 from elasticdl_tpu.analysis import rpc_conformance as rc
 from elasticdl_tpu.analysis import thread_provenance as tp
 
@@ -1021,6 +1022,10 @@ THREAD_PROV_GOOD = _fixture("thread_provenance_good.py")
 THREAD_PROV_BAD = _fixture("thread_provenance_bad.py")
 EXACT_GOOD = _fixture("exactness_lineage_good.py")
 EXACT_BAD = _fixture("exactness_lineage_bad.py")
+RES_LIFE_GOOD = _fixture("resource_lifecycle_good.py")
+RES_LIFE_BAD = _fixture("resource_lifecycle_bad.py")
+SHUT_ORDER_GOOD = _fixture("shutdown_order_good.py")
+SHUT_ORDER_BAD = _fixture("shutdown_order_bad.py")
 
 
 def test_fencing_flags_unfenced_handler_and_call_site(tmp_path):
@@ -1519,6 +1524,229 @@ def test_repo_trace_and_agg_knobs_registered():
         assert knob in ENV_REGISTRY and ENV_REGISTRY[knob].strip(), knob
 
 
+# -- resource-lifecycle --------------------------------------------------------
+
+
+def test_resource_lifecycle_flags_all_checks(tmp_path):
+    root = _tree(tmp_path, {"mod.py": RES_LIFE_BAD})
+    findings = run_analysis(root, rules=["resource-lifecycle"])
+    checks = _checks(findings, "resource-lifecycle")
+    assert checks == {
+        "leak-on-raise-path",
+        "start-without-join-or-daemon",
+        "acquire-without-finally",
+        "unreleased-escape",
+    }
+    msgs = [f.message for f in findings]
+    assert any("seg" in m and "publish" in m for m in msgs)
+    assert any("PoolOwner" in m and "_pool" in m for m in msgs)
+
+
+def test_resource_lifecycle_clean_under_all_rules(tmp_path):
+    root = _tree(tmp_path, {"mod.py": RES_LIFE_GOOD})
+    assert run_analysis(root) == []
+
+
+def test_resource_lifecycle_findings_carry_release_chain(tmp_path):
+    # the interprocedural hand-off is IN the finding: lend -> _checkin
+    # -> self._pool is the triage trail for where the release belongs
+    root = _tree(tmp_path, {"mod.py": RES_LIFE_BAD})
+    findings = run_analysis(root, rules=["resource-lifecycle"])
+    esc = next(f for f in findings if f.check == "unreleased-escape")
+    assert esc.chain == ("PoolOwner.lend", "PoolOwner._checkin", "self._pool")
+
+
+def test_resource_lifecycle_factory_return_propagates(tmp_path):
+    # a factory that RETURNS the resource transfers ownership to its
+    # caller — the caller inherits the release obligation
+    src = """import socket
+
+
+def make_conn(host):
+    conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        conn.connect(host)
+    except OSError:
+        conn.close()
+        raise
+    return conn
+
+
+def use(host, payload):
+    conn = make_conn(host)
+    conn.sendall(payload)
+"""
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["resource-lifecycle"])
+    assert [(f.check, f.chain) for f in findings] == [
+        ("leak-on-raise-path", ("use", "conn"))
+    ]
+
+
+def test_resource_lifecycle_acquire_then_try_finally_is_clean(tmp_path):
+    # the manual acquire immediately followed by try/finally release is
+    # THE sanctioned non-`with` shape; only the bare form is flagged
+    src = """def locked(lock):
+    lock.acquire()
+    try:
+        return 1
+    finally:
+        lock.release()
+"""
+    root = _tree(tmp_path, {"mod.py": src})
+    assert run_analysis(root, rules=["resource-lifecycle"]) == []
+
+
+def test_resource_lifecycle_suppression(tmp_path):
+    src = RES_LIFE_BAD.replace(
+        "def leaks_segment_on_raise(name, payload):",
+        "def leaks_segment_on_raise(name, payload):"
+        "  # edl-lint: disable=resource-lifecycle -- fixture keeps the"
+        " segment alive for a sibling process",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["resource-lifecycle"])
+    lines = {(f.check, f.message.split()[0]) for f in findings}
+    assert ("leak-on-raise-path", "leaks_segment_on_raise") not in lines
+    assert ("leak-on-raise-path", "never_released") in lines
+
+
+def test_repo_close_like_release_chains():
+    """The live tree's teardown chains the burn-down relies on, pinned
+    as negatives: ServerDispatcher drains its executor, StandbyMaster's
+    adoption-abort path joins the watch thread and stops the adopted
+    server, and AsyncUdsServer releases its asyncio server through the
+    _close_async hop — if a refactor breaks any of these hand-offs the
+    chain disappears and unreleased-escape fires on the tree."""
+    ctx = load_context(PKG_ROOT)
+    g = cg.CallGraph(ctx)
+    an = rl.Analysis(ctx, g)
+    an._summaries_fixpoint()
+    dispatcher = ("rpc/transport.py", "ServerDispatcher")
+    assert an.release_chain(dispatcher, "_executor") == (
+        "ServerDispatcher.close", "self._executor",
+    )
+    standby = ("master/migration.py", "StandbyMaster")
+    assert an.release_chain(standby, "_watch_thread") == (
+        "StandbyMaster.stop", "self._watch_thread",
+    )
+    assert an.release_chain(standby, "server") == (
+        "StandbyMaster.stop", "self.server",
+    )
+    auds = ("rpc/transport.py", "AsyncUdsServer")
+    assert an.release_chain(auds, "_server") == (
+        "AsyncUdsServer.close", "AsyncUdsServer._close_async", "self._server",
+    )
+
+
+# -- shutdown-order ------------------------------------------------------------
+
+
+def test_shutdown_order_flags_all_checks(tmp_path):
+    root = _tree(tmp_path, {"mod.py": SHUT_ORDER_BAD})
+    findings = run_analysis(root, rules=["shutdown-order"])
+    checks = _checks(findings, "shutdown-order")
+    assert checks == {
+        "join-under-lock",
+        "close-order-inversion",
+        "double-close-unsafe",
+    }
+    msgs = [f.message for f in findings]
+    assert any("_lock" in m and "join" in m for m in msgs)
+    assert any("_conn" in m and "_pump" in m for m in msgs)
+
+
+def test_shutdown_order_clean_under_all_rules(tmp_path):
+    root = _tree(tmp_path, {"mod.py": SHUT_ORDER_GOOD})
+    assert run_analysis(root) == []
+
+
+def test_shutdown_order_join_under_with_block_too(tmp_path):
+    # the `with` form of the same deadlock — the manual-acquire form is
+    # the fixture's; both must land on the join line
+    src = SHUT_ORDER_BAD.replace(
+        "        self._lock.acquire()\n"
+        "        try:\n"
+        "            self._t.join()\n"
+        "        finally:\n"
+        "            self._lock.release()",
+        "        with self._lock:\n"
+        "            self._t.join()",
+    )
+    assert "with self._lock" in src  # replacement applied
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["shutdown-order"])
+    assert "join-under-lock" in _checks(findings, "shutdown-order")
+
+
+def test_shutdown_order_wake_idiom_is_load_bearing(tmp_path):
+    # WakesTheReader is exempt ONLY because the thread sits in a
+    # blocking accept; turn the read into a write and the same
+    # close-before-join order becomes an inversion
+    src = SHUT_ORDER_GOOD.replace(
+        "self._sock.accept()", "self._sock.sendall(b'x')"
+    )
+    assert "sendall" in src  # replacement applied
+    root = _tree(tmp_path, {"mod.py": src})
+    findings = run_analysis(root, rules=["shutdown-order"])
+    assert _checks(findings, "shutdown-order") == {"close-order-inversion"}
+
+
+def test_shutdown_order_findings_carry_chain(tmp_path):
+    root = _tree(tmp_path, {"mod.py": SHUT_ORDER_BAD})
+    findings = run_analysis(root, rules=["shutdown-order"])
+    inv = next(f for f in findings if f.check == "close-order-inversion")
+    assert inv.chain[0] == "ClosesBeforeDrain.close"
+    assert "self._conn" in inv.chain and "self._pump" in inv.chain
+
+
+def test_shutdown_order_suppression(tmp_path):
+    src = SHUT_ORDER_BAD.replace(
+        "    def stop(self):",
+        "    def stop(self):  # edl-lint: disable=shutdown-order"
+        " -- the loop provably exits before stop in this fixture",
+    )
+    root = _tree(tmp_path, {"mod.py": src})
+    checks = _checks(
+        run_analysis(root, rules=["shutdown-order"]), "shutdown-order"
+    )
+    assert "join-under-lock" not in checks
+    assert "close-order-inversion" in checks  # other class: still on
+
+
+def test_cli_json_includes_chain(tmp_path, capsys):
+    root = _tree(tmp_path, {"mod.py": RES_LIFE_BAD})
+    assert (
+        lint_main(
+            [
+                "--root", root, "--rule", "resource-lifecycle",
+                "--no-baseline", "--format", "json",
+            ]
+        )
+        == 1
+    )
+    out = json.loads(capsys.readouterr().out)
+    esc = next(f for f in out["new"] if f["check"] == "unreleased-escape")
+    assert esc["chain"] == ["PoolOwner.lend", "PoolOwner._checkin", "self._pool"]
+
+
+def test_cli_stats_flag(tmp_path, capsys):
+    root = _tree(tmp_path, {"mod.py": RES_LIFE_BAD})
+    assert lint_main(["--root", root, "--no-baseline", "--stats"]) == 1
+    out = capsys.readouterr().out
+    assert "per-family counts" in out
+    # every selected family gets a row, firing or not
+    for family in ("resource-lifecycle", "shutdown-order", "lock-discipline"):
+        assert family in out
+    # json always carries the same table
+    assert (
+        lint_main(["--root", root, "--no-baseline", "--format", "json"]) == 1
+    )
+    stats = json.loads(capsys.readouterr().out)["stats"]
+    assert stats["resource-lifecycle"]["new"] == 5
+    assert stats["shutdown-order"]["new"] == 0
+
+
 # -- edl-verify: the call-graph engine -----------------------------------------
 
 
@@ -1606,6 +1834,8 @@ def test_cli_rule_selection(tmp_path, rule):
         "async-discipline": ASYNC_BAD,
         "thread-provenance": THREAD_PROV_BAD,
         "exactness-lineage": EXACT_BAD,
+        "resource-lifecycle": RES_LIFE_BAD,
+        "shutdown-order": SHUT_ORDER_BAD,
     }
     root = _tree(tmp_path, {"mod.py": sources[rule]})
     assert lint_main(["--root", root, "--rule", rule, "--no-baseline"]) == 1

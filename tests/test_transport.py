@@ -732,3 +732,91 @@ def test_shm_boot_reclaims_dead_predecessor(shm_env):
     finally:
         server.stop()
     assert _no_shm_segments(f".{scope}.")
+
+
+# -- resource lifecycle on the failure paths (regressions) --------------------
+
+
+@pytest.fixture
+def captured_sockets(monkeypatch):
+    """Every AF_UNIX socket the code under test creates, so the
+    failure-path tests can assert the fd was actually released."""
+    created = []
+    real_socket = socket.socket
+
+    def capture(*args, **kwargs):
+        s = real_socket(*args, **kwargs)
+        created.append(s)
+        return s
+
+    monkeypatch.setattr(transport.socket, "socket", capture)
+    return created
+
+
+def test_uds_server_bind_failure_closes_socket(
+    monkeypatch, tmp_path, captured_sockets
+):
+    # regression: a half-built listener has no owner — __init__ raised
+    # out of bind() with the fd still open, and every boot retry
+    # against an unusable path leaked another one
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path / ("x" * 200)))
+    disp = transport.ServerDispatcher(_echo_handlers(), WireStats("t"))
+    with pytest.raises(OSError):
+        transport.UdsServer(45997, disp)
+    assert captured_sockets
+    assert all(s.fileno() == -1 for s in captured_sockets)
+
+
+def test_async_uds_server_bind_failure_closes_socket(
+    monkeypatch, tmp_path, captured_sockets
+):
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path / ("x" * 200)))
+    disp = transport.ServerDispatcher(_echo_handlers(), WireStats("t"))
+    with pytest.raises(OSError):
+        transport.AsyncUdsServer(45997, disp, core=object())
+    assert captured_sockets
+    assert all(s.fileno() == -1 for s in captured_sockets)
+
+
+def test_shm_server_rendezvous_failure_cleans_up(
+    monkeypatch, tmp_path, captured_sockets
+):
+    # regression: a raise after segment-create but before the
+    # rendezvous write (the connect()-side mirror of the same bug)
+    # leaked the doorbell socket, the broadcast shm segment, and the
+    # half-written manifest — none had an owner to close them
+    monkeypatch.setenv(ENV_UDS_DIR, str(tmp_path))
+    scope = "bootfail"
+
+    def replace_fails(src, dst):
+        raise OSError("rendezvous write failed")
+
+    monkeypatch.setattr(transport.os, "replace", replace_fails)
+    disp = transport.ServerDispatcher(_echo_handlers(), WireStats("t"))
+    with pytest.raises(OSError, match="rendezvous write failed"):
+        transport.ShmServer(45996, disp, scope=scope)
+    assert _no_shm_segments(f".{scope}.")  # broadcaster segment freed
+    assert not os.path.exists(transport.shm_doorbell_path(45996))
+    assert not os.path.exists(
+        transport.shm_rendezvous_path(45996) + ".tmp"
+    )
+    assert all(s.fileno() == -1 for s in captured_sockets)
+
+
+def test_uds_transport_close_drains_pool(tmp_path):
+    # regression: UdsTransport had no close() at all — RpcClient's
+    # hasattr('close') hook found nothing and a dropped client
+    # stranded up to 8 pooled fds until GC
+    class _Conn:
+        def __init__(self):
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    t = transport.UdsTransport(str(tmp_path / "never.sock"))
+    conns = [_Conn(), _Conn(), _Conn()]
+    t._pool = list(conns)
+    t.close()
+    assert all(c.closed for c in conns)
+    assert t._pool == []
