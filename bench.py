@@ -68,6 +68,8 @@ def run_job(
     step_pipeline=0,
     spec_overrides=None,
     overlap_sync=None,
+    sync_local_steps=None,
+    sync_adaptive=None,
 ):
     """One full PS training job; returns (images_per_sec, worker, wall).
 
@@ -135,6 +137,8 @@ def run_job(
         sync_dtype=sync_dtype,
         sync_compress=sync_compress,
         overlap_sync=overlap_sync,
+        sync_local_steps=sync_local_steps,
+        sync_adaptive=sync_adaptive,
     )
 
     # ---- untimed AOT warm-up: compile + one throwaway execution ----
@@ -182,7 +186,13 @@ def run_job(
         # per-tier rollup (grpc/uds/inproc): co-located fast-path runs
         # must show ~0 bytes under "grpc" here
         "transports": wire.get("transports", {}),
+        # adaptive sync plane: per-form {bytes_sent, rounds} breakdown
+        # ({} unless sync_adaptive ran)
+        "wire_forms": wire.get("wire_forms", {}),
     }
+    # the adaptive plane's per-round decision log, verbatim — the
+    # honest-null contract forbids aggregating these away
+    worker.decision_log = worker.sync_decisions
     return n_records * epochs / elapsed, worker, elapsed
 
 
@@ -194,27 +204,15 @@ def jax_tree_map(f, tree):
 
 def _probe_link_mbps() -> float:
     """h2d link-bandwidth probe, run UNCONDITIONALLY around every
-    window run. BENCH_r05 shipped `link_mbps_per_run: []` /
-    `headline_link_mbps: null` because the probe hid behind an
-    `if on_tpu:` gate — the weather-normalization column the protocol
-    promises was silently empty. The probe is a plain jax.device_put
-    timing (bench_resnet.measure_link_bandwidth), which works on any
-    backend; if it cannot produce a positive number the bench FAILS
-    rather than report a run without its link weather."""
-    try:
-        from bench_resnet import measure_link_bandwidth
+    window run. Factored into elasticdl_tpu/common/linkprobe.py so the
+    worker's adaptive sync plane shares the same probe contract; this
+    wrapper keeps the bench's historical call sites. Fail-loud: a probe
+    that cannot produce a positive number FAILS the bench rather than
+    report a run without its link weather (see linkprobe.probe_link_mbps
+    for the BENCH_r05 postmortem)."""
+    from elasticdl_tpu.common.linkprobe import probe_link_mbps
 
-        mbps = float(measure_link_bandwidth())
-    except Exception as e:
-        raise RuntimeError(
-            f"link-bandwidth probe failed ({e!r}): refusing to report "
-            "a window run without link accounting"
-        ) from e
-    if not mbps > 0:
-        raise RuntimeError(
-            f"link-bandwidth probe returned non-positive {mbps!r}"
-        )
-    return mbps
+    return probe_link_mbps()
 
 
 def _pull_fanout_cell(
@@ -876,6 +874,108 @@ def main():
         file=sys.stderr,
     )
 
+    # ---- adaptive sync ladder A/B: link-weather wire selection ----
+    # Same job shape twice on the SERIAL sync chain (overlap off, so
+    # the wire choice is the only variable): fixed f32 wire vs the
+    # adaptive plane (--sync_adaptive on), which probes the link from
+    # each push's own timing and picks f32/bf16/int8/topk per round
+    # (common/sync_policy.decide). The CI-tracked headline is the
+    # weather-normalized imgs/sec per link-Mbps ratio adaptive/f32 plus
+    # each cell's MFU: on a link-bound host the ladder must win
+    # outright (the lighter rungs cut the serial push wall); on a
+    # compute-bound host adaptive converges to the f32 rung and the
+    # cells tie — the 0.95 floor absorbs scheduler noise there while
+    # still catching a ladder that picks pathological forms. The
+    # adaptive cell carries the per-round decision log VERBATIM
+    # (honest-null: aggregating "mostly f32" away would hide mixed
+    # rounds) and the per-form wire byte split.
+    adaptive_ab = {}
+    for mode in ("f32", "adaptive"):
+        ad_link_before = _probe_link_mbps()
+        ad_imgs, ad_worker, _ad_wall = run_job(
+            model_module,
+            path,
+            4096,
+            minibatch=minibatch,
+            records_per_task=512,
+            epochs=1,
+            local_updates=ab_w,
+            grads_to_wait=1,
+            sync_dtype=None,
+            sync_adaptive="on" if mode == "adaptive" else "off",
+            overlap_sync="off",
+        )
+        ad_link = round(max(ad_link_before, _probe_link_mbps()), 1)
+        ws = ad_worker.wire_summary
+        # exactness in every cell: version == init + applied update
+        # steps, whatever wire forms the rounds chose
+        assert (
+            ad_worker.final_version == ws["sync_calls"] * ab_w
+            and ws["sync_calls"] > 0
+        ), (
+            f"adaptive A/B mode={mode}: final version "
+            f"{ad_worker.final_version} != {ws['sync_calls']} applied "
+            f"pushes x {ab_w} steps — a wire form dropped or "
+            "double-applied a window"
+        )
+        ad_mfu = None
+        if getattr(ad_worker, "window_flops", None):
+            ad_per_image = ad_worker.window_flops / (ab_w * minibatch)
+            ad_mfu = ad_per_image * ad_imgs / 1e12 / 197.0
+        cell = {
+            "images_per_sec": round(ad_imgs, 1),
+            "link_mbps": ad_link,
+            "imgs_per_sec_per_link_mbps": round(ad_imgs / ad_link, 3)
+            if ad_link
+            else None,
+            "mfu_vs_v5e_bf16_peak": (
+                round(ad_mfu, 4) if ad_mfu is not None else None
+            ),
+            "final_version": ad_worker.final_version,
+            "applied_pushes": ws["sync_calls"],
+            "bytes_per_sync_up": ws["bytes_per_sync_up"],
+            "wire_forms": ws.get("wire_forms", {}),
+        }
+        if mode == "adaptive":
+            cell["decision_log"] = ad_worker.decision_log
+            assert cell["decision_log"], (
+                "sync_adaptive=on recorded no per-round decisions — "
+                "the worker's decide() call site is gone"
+            )
+        adaptive_ab[mode] = cell
+    _ad_plm = adaptive_ab["adaptive"]["imgs_per_sec_per_link_mbps"]
+    _f32_plm = adaptive_ab["f32"]["imgs_per_sec_per_link_mbps"]
+    adaptive_ab["per_link_ratio_adaptive_vs_f32"] = (
+        round(_ad_plm / _f32_plm, 3) if _ad_plm and _f32_plm else None
+    )
+    # the ladder never picks a rung heavier than f32, so its wire can
+    # only be lighter-or-equal — a heavier adaptive cell means the
+    # policy or the EF codec regressed
+    assert (
+        adaptive_ab["adaptive"]["bytes_per_sync_up"]
+        <= adaptive_ab["f32"]["bytes_per_sync_up"]
+    ), (
+        f"adaptive wire heavier than fixed f32: "
+        f"{adaptive_ab['adaptive']['bytes_per_sync_up']} > "
+        f"{adaptive_ab['f32']['bytes_per_sync_up']} B/sync"
+    )
+    _ad_ratio = adaptive_ab["per_link_ratio_adaptive_vs_f32"]
+    assert _ad_ratio is not None and _ad_ratio >= 0.95, (
+        f"adaptive sync ladder failed its acceptance gate: "
+        f"weather-normalized img/s per link-Mbps ratio adaptive/f32 = "
+        f"{_ad_ratio} (must be >= 0.95; > 1.0 expected when "
+        f"link-bound); decisions: "
+        f"{adaptive_ab['adaptive']['decision_log']}"
+    )
+    print(
+        f"bench[adaptive A/B]: "
+        f"{adaptive_ab['f32']['images_per_sec']} img/s f32 -> "
+        f"{adaptive_ab['adaptive']['images_per_sec']} img/s adaptive; "
+        f"per-link ratio {_ad_ratio}; forms "
+        f"{sorted(adaptive_ab['adaptive']['wire_forms'])}",
+        file=sys.stderr,
+    )
+
     # ---- north-star model: ResNet-50 chip throughput ----
     # (bench_resnet.py holds the full story incl. the elastic-runtime
     # number and the link physics; the chip number rides the driver's
@@ -985,6 +1085,13 @@ def main():
         # the sync plane, per cell, with exactness asserted; the gate
         # (exposed_fraction_drop >= 2) already passed above
         "overlap_ab": overlap_ab,
+        # adaptive sync ladder A/B (fixed f32 vs per-round decide(),
+        # serial chain): CI-tracked headline is
+        # per_link_ratio_adaptive_vs_f32 (weather-normalized) plus each
+        # cell's MFU; the adaptive cell carries its per-round decision
+        # log verbatim (form + probed link Mbps per round — never
+        # aggregated) and the per-form wire byte split
+        "adaptive_sync_ab": adaptive_ab,
         "resnet50_chip": resnet,
         "window_runs_images_per_sec": [
             round(a[0], 1) for a in attempts
@@ -1075,6 +1182,19 @@ def main():
             "(final PS version == applied pushes x window "
             "steps); imgs_per_sec_per_link_mbps normalizes "
             "each cell by its bracketing link probes. "
+            "adaptive_sync_ab is the adaptive-ladder A/B "
+            "(fixed f32 wire vs --sync_adaptive on, serial "
+            "chain, same shape): each round the worker probes "
+            "the link from its own push timing "
+            "(common/linkprobe.LinkWeather) and "
+            "sync_policy.decide picks f32/bf16/int8/topk; the "
+            "adaptive cell records every round's chosen form + "
+            "probed Mbps verbatim in decision_log (the "
+            "honest-null contract forbids aggregating mixed "
+            "rounds into a single label), with exactness and "
+            "bytes_per_sync_up <= f32 asserted, and the "
+            "CI-tracked headline is "
+            "per_link_ratio_adaptive_vs_f32 plus per-cell MFU. "
             "resnet50_chip is re-measured every round on every "
             "backend (off-TPU: a scaled-down shape labeled "
             "with its backend). "

@@ -1,0 +1,101 @@
+"""Link-weather probing and tracking for the adaptive sync plane.
+
+Two complementary sources of "link weather" — an estimate of the
+host<->master/PS link bandwidth that the sync plane rides on:
+
+- ``probe_link_mbps()``: the active h2d probe factored out of bench.py
+  (a plain jax.device_put timing). Fail-loud by contract: the bench
+  refuses to report a window run without link accounting, so a probe
+  that cannot produce a positive number raises instead of returning a
+  placeholder.
+
+- ``LinkWeather``: the passive tracker the worker's sync thread feeds
+  from the push timing it already has. Every window push knows how
+  many wire bytes it sent and how long the RPC took; that ratio IS a
+  bandwidth sample, with zero extra traffic. The tracker keeps a short
+  ring of recent samples and exposes a median-of-recent estimate that
+  is robust to the occasional stalled push.
+
+The pure per-round wire-form decision lives in sync_policy.decide();
+this module only measures.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+def probe_link_mbps() -> float:
+    """Active h2d link-bandwidth probe, run UNCONDITIONALLY around every
+    bench window run. BENCH_r05 shipped ``link_mbps_per_run: []`` /
+    ``headline_link_mbps: null`` because the probe hid behind an
+    ``if on_tpu:`` gate — the weather-normalization column the protocol
+    promises was silently empty. The probe is a plain jax.device_put
+    timing (bench_resnet.measure_link_bandwidth), which works on any
+    backend; if it cannot produce a positive number the caller FAILS
+    rather than report a run without its link weather."""
+    try:
+        from bench_resnet import measure_link_bandwidth
+
+        mbps = float(measure_link_bandwidth())
+    except Exception as e:
+        raise RuntimeError(
+            f"link-bandwidth probe failed ({e!r}): refusing to report "
+            "a window run without link accounting"
+        ) from e
+    if not mbps > 0:
+        raise RuntimeError(
+            f"link-bandwidth probe returned non-positive {mbps!r}"
+        )
+    return mbps
+
+
+class LinkWeather:
+    """Passive link-bandwidth tracker fed from sync-push timings.
+
+    Thread contract: ``observe`` is called from the worker's sync
+    threads (one at a time per worker — the sync chain serializes
+    pushes), ``mbps``/``history`` may be read from any thread. A small
+    internal lock covers the ring; no caller-visible locking.
+    """
+
+    def __init__(self, window: int = 8):
+        self._lock = threading.Lock()
+        self._samples: deque[float] = deque(maxlen=max(1, int(window)))
+        self._observations = 0
+
+    def observe(self, wire_bytes: int, seconds: float) -> None:
+        """Record one push: `wire_bytes` payload bytes took `seconds`.
+
+        Sub-millisecond or zero-byte pushes are discarded — they
+        measure dispatch overhead, not the link."""
+        if wire_bytes <= 0 or seconds <= 1e-3:
+            return
+        mbps = wire_bytes * 8.0 / (seconds * 1e6)
+        with self._lock:
+            self._samples.append(mbps)
+            self._observations += 1
+
+    def mbps(self) -> float | None:
+        """Median of the recent samples, or None before any sample —
+        callers (sync_policy.decide) must handle the cold start."""
+        with self._lock:
+            if not self._samples:
+                return None
+            ordered = sorted(self._samples)
+        mid = len(ordered) // 2
+        if len(ordered) % 2:
+            return ordered[mid]
+        return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+    def history(self) -> list[float]:
+        """Recent raw samples, oldest first (for decide()'s hysteresis
+        and the bench decision log)."""
+        with self._lock:
+            return list(self._samples)
+
+    @property
+    def observations(self) -> int:
+        with self._lock:
+            return self._observations

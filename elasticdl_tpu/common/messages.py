@@ -267,6 +267,31 @@ class PSPushDeltaRequest(_WireRequest):
 
 
 @dataclasses.dataclass
+class PSPushDeltaBucketRequest(_WireRequest):
+    """One layer-aligned bucket of a super-window delta (worker
+    streaming push, worker._sync_local_updates). All buckets of one
+    super-window share `report_key` (the dedup/lineage key); `offset`
+    places this bucket's slice inside the SHARD's slice, and
+    `bucket_index`/`num_buckets` let the shard detect the complete set
+    — partial sets park (like fan-in's CombineBuffer) and the whole
+    set applies atomically at the window boundary, so `version`
+    advances by `steps` exactly once. A replay of an already-applied
+    set dedups per bucket on `report_key`; a re-sent parked bucket
+    overwrites its slot idempotently."""
+
+    delta: Any = None
+    steps: int = 0
+    base_version: int = -1
+    offset: int = 0
+    bucket_index: int = 0
+    num_buckets: int = 1
+    want_model: bool = False
+    report_key: str = ""
+    model_dtype: Optional[str] = None
+    epoch: int = -1
+
+
+@dataclasses.dataclass
 class PSPushDeltaCombinedRequest(_WireRequest):
     """One presummed cohort forwarded by an aggregator node (agg/):
     `delta` is the f32 presum of the member deltas, `steps` the member
@@ -470,6 +495,7 @@ WIRE_SCHEMAS: Dict[str, type] = {
     "PSPull": PSPullRequest,
     "PSPushGrad": PSPushGradRequest,
     "PSPushDelta": PSPushDeltaRequest,
+    "PSPushDeltaBucket": PSPushDeltaBucketRequest,
     "PSPushDeltaCombined": PSPushDeltaCombinedRequest,
     "AggPushDelta": AggPushDeltaRequest,
     "AggStats": AggStatsRequest,

@@ -1,0 +1,89 @@
+"""Pure per-round wire-form policy for the adaptive sync ladder.
+
+``decide()`` maps (link weather, delta size, decision history) to one
+of the wire forms the PS already decodes per-push — mixed rounds are
+legal because every push carries its own form and the shared f32
+error-feedback residual on the worker absorbs whatever each round's
+compression dropped.
+
+The policy is a ladder over the projected f32 push time
+``t = delta_bytes * 8 / (link_mbps * 1e6)``:
+
+    ==============================  ======  ==========================
+    projected f32 push time t       form    rationale
+    ==============================  ======  ==========================
+    t <= 0.25 s                     f32     link affords exactness
+    0.25 s < t <= 1.0 s             bf16    2x cut, negligible loss
+    1.0 s  < t <= 4.0 s             int8    4x cut, EF-corrected
+    t > 4.0 s                       topk    max cut for storm weather
+    (no estimate yet — cold start)  bf16    mild lossy default
+    ==============================  ======  ==========================
+
+Hysteresis: when the projection lands within 20% of the boundary it
+would have to cross, the previous round's form is kept — link weather
+jitters several-fold between minutes and the ladder must not flap on
+every sample. The function is PURE: no clocks, no globals, no I/O —
+everything it needs arrives as arguments, so the policy is unit-testable
+and replayable from a bench decision log.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+# Rungs ordered from most to least wire bytes. These names are the
+# wire-form vocabulary used by WireStats' per-form counters and the
+# bench decision log; worker.py maps them onto its quantize modes.
+WIRE_FORMS = ("f32", "bf16", "int8", "topk")
+
+# Projected-f32-push-time boundaries (seconds) between adjacent rungs.
+_BOUNDARIES = (0.25, 1.0, 4.0)
+
+# Stay on the previous rung while the projection is within this factor
+# of the boundary it would have to cross.
+_HYSTERESIS = 0.20
+
+# Cold-start form before any link estimate exists.
+COLD_START_FORM = "bf16"
+
+
+def _last_form(history: Sequence[Any] | None) -> str | None:
+    """Previous round's form from a history of decisions — each entry
+    either a plain form string or a dict with a "form" key (the bench
+    decision-log record shape)."""
+    if not history:
+        return None
+    last = history[-1]
+    form = last.get("form") if isinstance(last, dict) else last
+    return form if form in WIRE_FORMS else None
+
+
+def projected_push_seconds(link_mbps: float, delta_bytes: int) -> float:
+    """Seconds an f32-sized push of `delta_bytes` takes at `link_mbps`."""
+    if link_mbps <= 0:
+        raise ValueError(f"link_mbps must be positive, got {link_mbps!r}")
+    return delta_bytes * 8.0 / (link_mbps * 1e6)
+
+
+def decide(
+    link_mbps: float | None,
+    delta_bytes: int,
+    history: Sequence[Any] | None = None,
+) -> str:
+    """Pick this round's wire form. See the module docstring for the
+    policy table; `history` (most recent last) supplies the previous
+    form for hysteresis and may be empty/None."""
+    if link_mbps is None:
+        return _last_form(history) or COLD_START_FORM
+    t = projected_push_seconds(link_mbps, delta_bytes)
+    rung = sum(1 for b in _BOUNDARIES if t > b)
+    prev = _last_form(history)
+    if prev is not None:
+        prev_rung = WIRE_FORMS.index(prev)
+        if abs(rung - prev_rung) == 1:
+            boundary = _BOUNDARIES[min(rung, prev_rung)]
+            lo = boundary * (1.0 - _HYSTERESIS)
+            hi = boundary * (1.0 + _HYSTERESIS)
+            if lo <= t <= hi:
+                return prev
+    return WIRE_FORMS[rung]

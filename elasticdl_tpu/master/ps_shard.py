@@ -131,6 +131,18 @@ class PSShardServicer:
         # here, not double-apply)
         self._duplicate_pushes = 0
         self._applied_pushes = 0
+        # Bucketed-push parking (PSPushDeltaBucket): partial bucket
+        # sets park here keyed by report_key — bucket_index ->
+        # (offset, dense f32 part) — until num_buckets parts arrived,
+        # then the WHOLE set applies atomically under self._lock (the
+        # fan-in CombineBuffer's park-then-apply shape, per super-window
+        # instead of per cohort). A re-sent parked part overwrites its
+        # slot idempotently. Capacity-capped like the dedup ring: an
+        # abandoned partial set (worker died mid-stream — its delta
+        # never applies, matching a dropped flat push) must not leak.
+        self._parked_buckets: "OrderedDict[str, dict]" = OrderedDict()
+        self._parked_cap = 64
+        self._parked_evictions = 0
         # wire-byte accounting: the hosting RpcServer's WireStats,
         # attached by shard_host/ps_group after server construction so
         # `stats()` answers bytes questions over the existing stats RPC
@@ -192,6 +204,7 @@ class PSShardServicer:
             "PSPull": self.pull,
             "PSPushGrad": self.push_grad,
             "PSPushDelta": self.push_delta,
+            "PSPushDeltaBucket": self.push_delta_bucket,
             "PSPushDeltaCombined": self.push_delta_combined,
             "PSOptState": self.opt_state,
             "PSOptRestore": self.opt_restore,
@@ -620,6 +633,84 @@ class PSShardServicer:
             resp["vec"] = self._wire_vec(req)
         return resp
 
+    def push_delta_bucket(self, req: dict) -> dict:
+        """One layer-aligned bucket of a super-window delta (the
+        worker's streaming push, ps_client.push_delta_bucketed). Parts
+        of one super-window share `report_key`; partial sets PARK (the
+        fan-in CombineBuffer's park-then-apply shape) and the full set
+        applies atomically at the window boundary — `version` advances
+        by `steps` exactly once, and `_record_applied` registers the
+        key only then, so:
+
+        - a replayed part of an already-applied set dedups
+          (`_is_duplicate`) and answers like push_delta's duplicate
+          path — the retrying/replaying worker rebases onto the result;
+        - a re-sent parked part overwrites its slot idempotently;
+        - a worker dying mid-stream leaves a partial set that never
+          applies (eventually evicted), exactly like a flat push whose
+          RPC never arrived."""
+        self._check_epoch(req)
+        key = req.get("report_key") or ""
+        if not key:
+            raise ValueError("bucketed push requires a report_key")
+        # decode to the dense f32 part OUTSIDE the lock (push_delta's
+        # contract: compression never leaks into the apply math)
+        part = codec.delta_to_f32(req["delta"])
+        idx = int(req.get("bucket_index", 0))
+        total = int(req.get("num_buckets", 1))
+        offset = int(req.get("offset", 0))
+        with obs_trace.span(
+            "ps.apply",
+            cat="ps",
+            args={"shard": self.shard_id, "kind": "delta_bucket"},
+        ):
+            with self._lock:
+                if self._vec is None:
+                    raise ValueError("delta pushed before shard init")
+                if self._is_duplicate(req):
+                    return {
+                        "version": self._version,
+                        "vec": self._wire_vec(req),
+                        "duplicate": True,
+                    }
+                if offset < 0 or offset + part.shape[0] > self._vec.shape[0]:
+                    raise ValueError(
+                        f"bucket [{offset}, {offset + part.shape[0]}) "
+                        f"outside slice of {self._vec.shape[0]}"
+                    )
+                parked = self._parked_buckets.get(key)
+                if parked is None:
+                    parked = self._parked_buckets[key] = {}
+                    while len(self._parked_buckets) > self._parked_cap:
+                        self._parked_buckets.popitem(last=False)
+                        self._parked_evictions += 1
+                parked[idx] = (offset, part)
+                if len(parked) < total:
+                    # incomplete set: nothing applied yet (atomicity —
+                    # the model other pullers see never contains a
+                    # torn super-window)
+                    return {"version": self._version, "parked": len(parked)}
+                del self._parked_buckets[key]
+                steps = int(req["steps"])
+                base_version = int(req["base_version"])
+                scale = 1.0
+                if self._staleness_window:
+                    staleness = self._version - base_version
+                    if staleness > self._staleness_window:
+                        scale = self._staleness_window / float(staleness)
+                for off, d in parked.values():
+                    self._vec[off:off + d.shape[0]] += (
+                        scale * d if scale != 1.0 else d
+                    )
+                self._version += steps
+                self._record_applied(req)
+                resp = {"version": self._version}
+                if base_version + steps != self._version or req.get(
+                    "want_model"
+                ):
+                    resp["vec"] = self._wire_vec(req)
+                return resp
+
     def push_delta_combined(self, req: dict):  # edl-lint: disable=exactness-lineage -- deliberately unclassified (rpc/policy.py): a combined forward carries k member keys and is NEVER resent as-is — forward failure errors the members, who each retry DIRECT under their own dedup key
         """One presummed cohort from an aggregator node (agg/): apply
         the combined delta once, register EVERY member report_key, and
@@ -860,6 +951,12 @@ class PSShardServicer:
                 # (1.0 when combining is off or every batch had k=1)
                 "combined_batches": self._combined_batches,
                 "combined_reports": self._combined_reports,
+                # bucketed-push parking: partial super-window sets
+                # currently parked + abandoned sets evicted (a healthy
+                # run shows 0 evictions — parked sets complete within
+                # one push)
+                "parked_bucket_sets": len(self._parked_buckets),
+                "parked_bucket_evictions": self._parked_evictions,
             }
         with self._prepack_lock:
             # pull amortization evidence: served / encodes is the

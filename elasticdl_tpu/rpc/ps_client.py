@@ -384,6 +384,78 @@ class ShardedPS:
         }
         return [r["version"] for r in resps], merged
 
+    def push_delta_bucketed(
+        self,
+        delta,
+        steps: int,
+        base_versions: List[int],
+        bucket_bounds: List[int],
+        model_dtype: Optional[str] = None,
+        want_model: bool = False,
+        report_key: Optional[str] = None,
+    ) -> Tuple[List[int], Dict[int, np.ndarray]]:
+        """Streaming window-delta fan-out: the delta is cut at
+        `bucket_bounds` (absolute [0, c1, ..., n] — layer-aligned by
+        the worker) and each shard receives its intersection with each
+        bucket as a SEQUENCE of PSPushDeltaBucket parts under ONE
+        `report_key`. The shard parks parts until the set is complete,
+        then applies atomically (version advances by `steps` once), so
+        the first bytes fly while later layers are still materializing
+        and replay/dedup semantics match push_delta exactly: a resend
+        of an already-applied set dedups per part, a re-sent parked
+        part overwrites idempotently. Shards stream in parallel; parts
+        within a shard stay in order (the stream IS the pipeline).
+        Always direct — the aggregation-tree route only understands
+        whole-slice pushes. Returns (shard_versions,
+        {shard_index: merged_slice}) like push_delta."""
+        if not isinstance(delta, (codec.QuantizedDelta, codec.SparseDelta)):
+            delta = np.asarray(delta)
+        size = codec.delta_length(delta)
+        if size != self.n_params:
+            raise ValueError(f"delta size {size} != {self.n_params}")
+        cuts = list(bucket_bounds)
+        if (
+            len(cuts) < 2
+            or cuts[0] != 0
+            or cuts[-1] != size
+            or any(b <= a for a, b in zip(cuts, cuts[1:]))
+        ):
+            raise ValueError(f"malformed bucket bounds {bucket_bounds!r}")
+
+        report_key = report_key or uuid.uuid4().hex
+
+        def do(c, i):
+            s, e = self.bounds[i]
+            parts = [
+                (max(bs, s), min(be, e))
+                for bs, be in zip(cuts, cuts[1:])
+                if max(bs, s) < min(be, e)
+            ]
+            if not parts:  # empty shard slice (more shards than params)
+                parts = [(s, s)]
+            resp = None
+            for j, (ps_, pe) in enumerate(parts):
+                req = {
+                    "delta": codec.slice_delta(delta, ps_, pe),
+                    "steps": steps,
+                    "base_version": base_versions[i],
+                    "offset": ps_ - s,
+                    "bucket_index": j,
+                    "num_buckets": len(parts),
+                    "want_model": want_model,
+                    "report_key": report_key,
+                }
+                if model_dtype:
+                    req["model_dtype"] = model_dtype
+                resp = c.call("PSPushDeltaBucket", self._stamp_epoch(req, i))
+            return resp  # the final part's response carries the apply
+
+        resps = self._map(do)
+        merged = {
+            i: r["vec"] for i, r in enumerate(resps) if r.get("vec") is not None
+        }
+        return [r["version"] for r in resps], merged
+
     def push_grad(
         self,
         grad: np.ndarray,

@@ -45,13 +45,18 @@ from elasticdl_tpu.common.constants import (
     ENV_BET_PREFETCH,
     ENV_OVERLAP_SYNC,
     ENV_SCHED_PHASE_SECS,
+    ENV_SYNC_ADAPTIVE,
+    ENV_SYNC_BUCKET_BYTES,
     ENV_SYNC_COMPRESS,
     ENV_SYNC_DEPTH,
     ENV_SYNC_DTYPE,
+    ENV_SYNC_LOCAL_STEPS,
     MAX_MINIBATCH_RETRY_NUM,
     Mode,
 )
 from elasticdl_tpu.common import codec
+from elasticdl_tpu.common import sync_policy
+from elasticdl_tpu.common.linkprobe import LinkWeather
 from elasticdl_tpu.common.log_util import get_logger
 from elasticdl_tpu.common.timing import PhaseTimers
 from elasticdl_tpu.obs import trace as obs_trace
@@ -153,6 +158,9 @@ class Worker:
         sync_compress: Optional[str] = None,  # "topk:<ratio>" sparsification
         overlap_sync: Optional[str] = None,  # on|off overlap plane gate
         master_candidates=None,  # master-failover endpoints (migration.py)
+        sync_local_steps: Optional[int] = None,  # k windows per push (ladder)
+        sync_adaptive: Optional[str] = None,  # on|off per-round wire form
+        sync_bucket_bytes: Optional[int] = None,  # layer-aligned bucket size
     ):
         self._id = worker_id
         self._master = master
@@ -218,6 +226,27 @@ class Worker:
         if sync_compress is None:
             sync_compress = os.environ.get(ENV_SYNC_COMPRESS, "") or ""
         self._topk_ratio = _parse_sync_compress(sync_compress)
+        # Link-weather-adaptive wire selection (--sync_adaptive /
+        # EDL_SYNC_ADAPTIVE): each round sync_policy.decide() maps the
+        # passive link estimate (push timings the sync thread already
+        # has — see LinkWeather) to f32/bf16/int8/topk. Mixed rounds
+        # are legal: the PS decodes every wire form per-push, and the
+        # shared f32 EF residual carries each round's compression error
+        # into the NEXT round regardless of either round's form.
+        # Parsed before the transport_dtype supersede below: adaptive
+        # counts as lossy (_lossy_sync), so it too needs the
+        # full-precision delta as the residual source.
+        if sync_adaptive is None:
+            sync_adaptive = os.environ.get(ENV_SYNC_ADAPTIVE, "") or "off"
+        sync_adaptive = str(sync_adaptive).strip().lower()
+        if sync_adaptive in ("", "off", "0", "false"):
+            self._sync_adaptive = False
+        elif sync_adaptive in ("on", "1", "true"):
+            self._sync_adaptive = True
+        else:
+            raise ValueError(
+                f"unsupported sync_adaptive {sync_adaptive!r} (on|off)"
+            )
         if self._lossy_sync() and transport_dtype == "bfloat16":
             # EF compression needs the FULL-precision delta/grad as its
             # input (residual = f32 - compress(f32)); the legacy step-fn
@@ -309,6 +338,60 @@ class Worker:
             )
         if not self._overlap_sync:
             self._max_inflight_syncs = 0
+        # Local-steps ladder (--sync_local_steps / EDL_SYNC_LOCAL_STEPS):
+        # accumulate k windows of on-device deltas before pushing ONE
+        # combined super-window delta. The delta is already cumulative
+        # (_flat - _base_flat), so the ladder is purely a higher spawn
+        # threshold — no new buffers — and one report_key covers the
+        # whole super-window (dedup/replay semantics unchanged). The EF
+        # residuals absorb compression error across the longer horizon
+        # exactly as across windows. k=1 restores today's per-window
+        # chain bit-for-bit.
+        if sync_local_steps is None:
+            sync_local_steps = os.environ.get(ENV_SYNC_LOCAL_STEPS, "") or 1
+        try:
+            sync_local_steps = int(sync_local_steps)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"unsupported sync_local_steps {sync_local_steps!r} (int >= 1)"
+            )
+        if sync_local_steps < 1:
+            raise ValueError(
+                f"unsupported sync_local_steps {sync_local_steps!r} (int >= 1)"
+            )
+        self._sync_local_steps = sync_local_steps
+        self._link_weather = LinkWeather()
+        # per-round decision log: {round, form, link_mbps, delta_bytes,
+        # steps}. Appended at sync SPAWN (spawns are sequential, like
+        # the EF residual handoff) and read by bench.py's decision log
+        # after the chain settles.
+        self._sync_decisions: list = []
+        # Bucketed delta push (--sync_bucket_bytes /
+        # EDL_SYNC_BUCKET_BYTES): split the super-window delta into
+        # ~this-many-byte layer-aligned buckets (template leaf
+        # boundaries) and stream them; the PS shard parks partial sets
+        # and applies the full set atomically at the window boundary.
+        # Sharded-PS route only — the single-master path keeps flat
+        # pushes (its ReportLocalUpdate carries task metadata the
+        # bucket RPC does not).
+        if sync_bucket_bytes is None:
+            sync_bucket_bytes = (
+                os.environ.get(ENV_SYNC_BUCKET_BYTES, "") or 0
+            )
+        try:
+            sync_bucket_bytes = int(sync_bucket_bytes)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"unsupported sync_bucket_bytes {sync_bucket_bytes!r} "
+                "(int >= 0)"
+            )
+        if sync_bucket_bytes < 0:
+            raise ValueError(
+                f"unsupported sync_bucket_bytes {sync_bucket_bytes!r} "
+                "(int >= 0)"
+            )
+        self._sync_bucket_bytes = sync_bucket_bytes
+        self._bucket_bounds = None  # lazy: layer-aligned cut points
         # Async model-down absorb: a daemon thread pulls the announced
         # newer model (over shm this maps the prepacked broadcast
         # segment — a zero-copy page-in) and stages it in
@@ -896,8 +979,15 @@ class Worker:
 
     def _lossy_sync(self) -> bool:
         """Whether the up-direction sync plane is lossy (EF-compressed):
-        bf16/int8 quantization or top-k sparsification."""
-        return self._sync_dtype in ("bfloat16", "int8") or self._topk_ratio > 0
+        bf16/int8 quantization or top-k sparsification. Adaptive mode
+        counts as lossy — any given round MAY pick a lossy form, so the
+        residual machinery must be engaged (an adaptive f32 round still
+        folds in and clears the residual; see _ef_quantize_delta)."""
+        return (
+            self._sync_adaptive
+            or self._sync_dtype in ("bfloat16", "int8")
+            or self._topk_ratio > 0
+        )
 
     def _model_wire_dtype(self):
         """Dtype requested for model-DOWN payloads (pull / piggyback).
@@ -947,19 +1037,23 @@ class Worker:
         deq = (q.astype(jnp.float32) * scale[:, None]).reshape(-1)[:n]
         return q.reshape(-1)[:n], scale, deq
 
-    def _ef_compress(self, comp, topk: bool):
+    def _ef_compress(self, comp, topk: bool, dtype=None, ratio=None):
         """Compress `comp` (delta-or-grad + residual, f32 device) per
-        the configured knobs. Returns (meta, dev_arrays, residual):
-        meta is a static descriptor consumed by _materialize_wire_delta
-        after device_get, dev_arrays the device payload, residual the
-        new on-device f32 error mass."""
+        the configured knobs — or per a per-round override (`dtype`,
+        `ratio`) when the adaptive plane picks this round's form.
+        Returns (meta, dev_arrays, residual): meta is a static
+        descriptor consumed by _materialize_wire_delta after
+        device_get, dev_arrays the device payload, residual the new
+        on-device f32 error mass."""
+        dtype = self._sync_dtype if dtype is None else dtype
         if topk:
+            ratio = self._topk_ratio if ratio is None else ratio
             n = int(comp.shape[0])
-            k = min(n, max(1, int(round(self._topk_ratio * n))))
+            k = min(n, max(1, int(round(ratio * n))))
             _, idx = jax.lax.top_k(jnp.abs(comp), k)
             idx = jnp.sort(idx)  # sorted => PS-shard slicing is a range
             vals = comp[idx]
-            if self._sync_dtype == "int8":
+            if dtype == "int8":
                 q, scale, sent = self._int8_quantize_dev(vals)
                 residual = comp.at[idx].set(vals - sent)
                 return (
@@ -967,7 +1061,7 @@ class Worker:
                     (idx, q, scale),
                     residual,
                 )
-            if self._sync_dtype == "bfloat16":
+            if dtype == "bfloat16":
                 qv = vals.astype(jnp.bfloat16)
                 sent = qv.astype(jnp.float32)
                 residual = comp.at[idx].set(vals - sent)
@@ -975,7 +1069,7 @@ class Worker:
             # exact values: the only error mass is the dropped tail
             residual = comp.at[idx].set(0.0)
             return ("topk", n, "float32"), (idx, vals), residual
-        if self._sync_dtype == "int8":
+        if dtype == "int8":
             q, scale, deq = self._int8_quantize_dev(comp)
             return ("int8", codec.DEFAULT_INT8_CHUNK), (q, scale), comp - deq
         # bfloat16 dense cast (the PR 5 plane)
@@ -1005,21 +1099,52 @@ class Worker:
             )
         raise ValueError(f"unknown wire-delta meta {meta!r}")
 
-    def _ef_quantize_delta(self, delta_dev):
+    def _ef_quantize_delta(self, delta_dev, form=None):
         """Window-delta EF (called at sync SPAWN on the main thread —
         spawns are sequential, so the residual handoff needs no lock).
         The residual is folded into the next window even when windows
         overlap in flight: each spawn consumes the residual left by the
-        previous spawn, preserving the telescoping sum. Returns
-        (meta, dev_arrays) for _materialize_wire_delta."""
+        previous spawn, preserving the telescoping sum. `form` is the
+        adaptive plane's per-round pick (sync_policy.WIRE_FORMS); None
+        keeps the statically configured knobs. An adaptive "f32" round
+        ships the residual-corrected delta exactly and clears the
+        residual (compress = identity). Returns (meta, dev_arrays) for
+        _materialize_wire_delta."""
         if self._ef_residual is None or (
             self._ef_residual.shape != delta_dev.shape
         ):
             self._ef_residual = jnp.zeros_like(delta_dev)
         comp = delta_dev + self._ef_residual
-        meta, arrays, residual = self._ef_compress(
-            comp, topk=self._topk_ratio > 0
-        )
+        if form is None:
+            meta, arrays, residual = self._ef_compress(
+                comp, topk=self._topk_ratio > 0
+            )
+        elif form == "f32":
+            meta, arrays, residual = (
+                ("dense",),
+                (comp,),
+                jnp.zeros_like(comp),
+            )
+        elif form == "bf16":
+            meta, arrays, residual = self._ef_compress(
+                comp, topk=False, dtype="bfloat16"
+            )
+        elif form == "int8":
+            meta, arrays, residual = self._ef_compress(
+                comp, topk=False, dtype="int8"
+            )
+        elif form == "topk":
+            # exact kept values; the configured ratio if one is set,
+            # else a storm-weather default that still ships the bulk of
+            # the delta's magnitude
+            meta, arrays, residual = self._ef_compress(
+                comp,
+                topk=True,
+                dtype="float32",
+                ratio=self._topk_ratio or 0.1,
+            )
+        else:
+            raise ValueError(f"unknown adaptive wire form {form!r}")
         self._ef_residual = residual
         return meta, arrays
 
@@ -1477,9 +1602,12 @@ class Worker:
         self._aux = new_aux or self._aux
         self._pending_steps += 1
         self._latest_step_loss = loss
-        if self._pending_steps >= self._local_updates:
+        if self._pending_steps >= self._local_updates * self._sync_local_steps:
             # async: the delta d2h + RPC ride a background thread while
-            # the device starts the next window (double-buffering)
+            # the device starts the next window (double-buffering).
+            # With the local-steps ladder (k > 1) the threshold is k
+            # windows: the cumulative delta keeps growing on device and
+            # ONE push covers the super-window.
             self._sync_local_updates(blocking=False)
         return loss  # device array; resolve lazily so steps pipeline
 
@@ -1547,7 +1675,8 @@ class Worker:
         self._aux = new_aux or self._aux
         self._pending_steps += self._local_updates
         self._latest_step_loss = loss
-        self._sync_local_updates(blocking=False)
+        if self._pending_steps >= self._local_updates * self._sync_local_steps:
+            self._sync_local_updates(blocking=False)
         return loss
 
     def _run_local_windows(self, batches, task: Task):
@@ -1671,6 +1800,26 @@ class Worker:
             return
         delta_dev = self._flat - self._base_flat  # own buffer, thread-safe
         wire_meta = None
+        wire_form = None
+        link_mbps = None
+        delta_f32_bytes = int(delta_dev.shape[0]) * 4
+        if self._sync_adaptive:
+            # per-round wire-form pick from the passive link estimate
+            # (sync_policy.decide is pure; LinkWeather holds the push
+            # timings the sync threads already measured). Decided at
+            # spawn, like the EF residual handoff — spawns are
+            # sequential, so the decision log needs no lock.
+            link_mbps = self._link_weather.mbps()
+            wire_form = sync_policy.decide(
+                link_mbps, delta_f32_bytes, self._sync_decisions
+            )
+        wspan_args = {"worker": self._id}
+        if wire_form is not None:
+            # the round's decision rides the window span for the
+            # critical-path/decision audits (bench decision log)
+            wspan_args["wire_form"] = wire_form
+            if link_mbps is not None:
+                wspan_args["link_mbps"] = round(link_mbps, 2)
         # one trace per window: the spawn-side quantize and the async
         # sync chain (encode / push RPCs / apply) all hang off this
         # root; it ends when do_sync settles, so its duration IS the
@@ -1679,7 +1828,7 @@ class Worker:
             "worker.window_sync",
             cat="worker",
             root=True,
-            args={"worker": self._id},
+            args=wspan_args,
         )
         if self._lossy_sync():
             # EF compression at spawn time, still on the main thread:
@@ -1692,11 +1841,23 @@ class Worker:
                 cat="worker",
                 parent=wspan.ctx if wspan is not None else None,
             ):
-                wire_meta, delta_dev = self._ef_quantize_delta(delta_dev)
+                wire_meta, delta_dev = self._ef_quantize_delta(
+                    delta_dev, form=wire_form
+                )
         elif self._transport_dtype == "bfloat16" and _BF16 is not None:
             # plain cast on DEVICE: halves the per-window d2h bytes
             delta_dev = delta_dev.astype(jnp.bfloat16)
         steps = self._pending_steps
+        if wire_form is not None:
+            self._sync_decisions.append(
+                {
+                    "round": len(self._sync_decisions),
+                    "form": wire_form,
+                    "link_mbps": link_mbps,
+                    "delta_bytes": delta_f32_bytes,
+                    "steps": steps,
+                }
+            )
         # dedup key, fixed at spawn: deterministic when the task carries
         # a dispatcher spec_key (speculation-stable — both copies of a
         # speculated task name this window identically), else a fresh
@@ -1838,13 +1999,31 @@ class Worker:
                     if spawn_shard_bases is not None
                     else [base_version] * self._ps.num_shards
                 )
-                versions, merged = self._ps.push_delta(
-                    delta_h,
-                    steps,
-                    base_versions,
-                    model_dtype=req.get("model_dtype"),
-                    report_key=report_key,
-                )
+                push_t0 = time.monotonic()
+                if self._sync_bucket_bytes:
+                    # bucketed push: layer-aligned buckets stream to
+                    # each shard under ONE report_key; the shard parks
+                    # partial sets and applies atomically at the
+                    # window boundary (ps_shard.push_delta_bucket)
+                    versions, merged = self._ps.push_delta_bucketed(
+                        delta_h,
+                        steps,
+                        base_versions,
+                        bucket_bounds=self._bucket_bounds_for(
+                            codec.delta_length(delta_h)
+                        ),
+                        model_dtype=req.get("model_dtype"),
+                        report_key=report_key,
+                    )
+                else:
+                    versions, merged = self._ps.push_delta(
+                        delta_h,
+                        steps,
+                        base_versions,
+                        model_dtype=req.get("model_dtype"),
+                        report_key=report_key,
+                    )
+                self._observe_push(delta_h, push_t0, wire_form)
                 meta = {
                     "worker_id": self._id,
                     "versions": versions,
@@ -1867,7 +2046,9 @@ class Worker:
                     resp["aux"] = meta_resp.get("aux")
             else:
                 versions = None
+                push_t0 = time.monotonic()
                 resp = self._call_master("ReportLocalUpdate", req)
+                self._observe_push(delta_h, push_t0, wire_form)
             with self._report_lock:
                 if epoch != self._sync_epoch:
                     return  # reset raced the RPC: discard the response
@@ -1949,6 +2130,71 @@ class Worker:
                 with self.timers.phase("sync_wait"):
                     with self._sync_exposed("backpressure"):
                         self._sync_inflight.popleft().join()
+
+    @property
+    def sync_decisions(self):
+        """Copy of the adaptive plane's per-round decision log (bench
+        decision JSON / CI artifact). Empty unless --sync_adaptive on."""
+        return [dict(d) for d in self._sync_decisions]
+
+    def _observe_push(self, delta_h, t0, wire_form):
+        """Post-push accounting on the sync thread: feed the passive
+        link tracker from the round-trip the push just paid (the cheap
+        per-round probe — zero extra traffic), and stamp the round's
+        chosen wire form into WireStats' per-form breakdown."""
+        wire_bytes = codec.delta_nbytes(delta_h)
+        self._link_weather.observe(wire_bytes, time.monotonic() - t0)
+        if wire_form is not None:
+            wire = getattr(self._master, "wire", None)
+            if wire is not None and hasattr(wire, "record_wire_form"):
+                wire.record_wire_form(wire_form, wire_bytes)
+
+    def _bucket_bounds_for(self, n: int):
+        """Layer-aligned cut points for the bucketed push: greedy
+        packing of template leaves into ~_sync_bucket_bytes (f32)
+        buckets, never splitting a leaf smaller than the budget —
+        buckets land on layer boundaries so a bucket's slice is a
+        whole number of layers whenever layers fit the budget. Falls
+        back to fixed-size cuts when no template is known (pre-init).
+        Returns [0, c1, ..., n] (adjacent [ci, ci+1) are the buckets),
+        cached until the flat size changes."""
+        if self._bucket_bounds is not None and self._bucket_bounds[-1] == n:
+            return self._bucket_bounds
+        budget = max(1, self._sync_bucket_bytes // 4)  # f32 elements
+        leaf_sizes = []
+        if self._template is not None:
+            leaf_sizes = [
+                int(np.asarray(leaf).size)
+                for leaf in jax.tree_util.tree_leaves(self._template)
+            ]
+        if not leaf_sizes or sum(leaf_sizes) != n:
+            leaf_sizes = [budget] * (n // budget)
+            if n % budget:
+                leaf_sizes.append(n % budget)
+        bounds = [0]
+        fill = 0
+        for size in leaf_sizes:
+            if size < budget:
+                if fill and fill + size > budget:
+                    # next layer would overflow: close this bucket at
+                    # the layer boundary (buckets are layer-aligned)
+                    bounds.append(bounds[-1] + fill)
+                    fill = 0
+                fill += size
+            else:
+                # oversized leaf: flush, then split it at the budget
+                # so one giant layer cannot defeat the streaming
+                if fill:
+                    bounds.append(bounds[-1] + fill)
+                    fill = 0
+                while size >= budget:
+                    bounds.append(bounds[-1] + budget)
+                    size -= budget
+                fill = size
+        if fill:
+            bounds.append(bounds[-1] + fill)
+        self._bucket_bounds = bounds
+        return bounds
 
     def _record_synced_losses(self, losses, loss_h, version):
         """Task losses resolve on the sync thread (batched with the

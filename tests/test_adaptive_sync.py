@@ -1,0 +1,437 @@
+"""Adaptive sync ladder (common/sync_policy + common/linkprobe),
+local-steps accumulation, and the bucketed per-layer delta push.
+
+The contract under test, layer by layer:
+
+- sync_policy.decide() is a pure ladder over the projected f32 push
+  time with hysteresis — replayable from a bench decision log.
+- LinkWeather turns push timings the sync thread already has into a
+  robust (median-of-recent) bandwidth estimate, discarding samples
+  that measure dispatch overhead rather than the link.
+- The local-steps ladder (k windows per push) is EXACT re-bracketing:
+  k=2 x W=2 must reproduce the k=1 x W=4 trajectory bit-for-bit, and
+  the k=1/adaptive-off defaults must be bit-identical to a knobless
+  run (today's chain).
+- Bucketed pushes cut the delta at layer-aligned bounds; adjacent
+  bucket slices reassemble bit-identically in EVERY wire form, the
+  shard parks partial sets (atomic apply), and the bucketed job lands
+  on the same model as the flat job to the last bit.
+"""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.api.model_spec_helpers import spec_from_module
+from elasticdl_tpu.common import codec, sync_policy
+from elasticdl_tpu.common.constants import (
+    ENV_SYNC_ADAPTIVE,
+    ENV_SYNC_BUCKET_BYTES,
+    ENV_SYNC_LOCAL_STEPS,
+)
+from elasticdl_tpu.common.linkprobe import LinkWeather
+from elasticdl_tpu.master.ps_group import PSShardGroup
+from elasticdl_tpu.master.ps_shard import PSShardServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.testing import (
+    InProcessMaster,
+    build_job,
+    write_linear_records,
+)
+from elasticdl_tpu.worker.worker import Worker
+
+from tests.fixtures import linear_module
+
+
+def _dummy_worker(**kwargs):
+    return Worker(
+        0,
+        None,
+        spec_from_module(linear_module),
+        minibatch_size=4,
+        **kwargs,
+    )
+
+
+# -- sync_policy: the pure per-round ladder ----------------------------------
+
+
+def test_decide_policy_table_rungs():
+    """Each projected-push-time band maps to its documented rung
+    (1 MB delta; the link speed picks the band)."""
+    mb = 1_000_000  # 8e6 bits on the wire as f32
+    # t = 8e6 / (mbps * 1e6): 80 Mbps -> 0.1s, 10 -> 0.8s, 4 -> 2s,
+    # 1 -> 8s
+    assert sync_policy.decide(80.0, mb) == "f32"
+    assert sync_policy.decide(10.0, mb) == "bf16"
+    assert sync_policy.decide(4.0, mb) == "int8"
+    assert sync_policy.decide(1.0, mb) == "topk"
+
+
+def test_decide_cold_start_and_history_fallback():
+    """No link estimate: mild lossy default, or the previous round's
+    form when a history exists (both decision-log dicts and plain
+    strings are accepted)."""
+    assert sync_policy.decide(None, 123) == sync_policy.COLD_START_FORM
+    assert sync_policy.decide(None, 123, [{"form": "int8"}]) == "int8"
+    assert sync_policy.decide(None, 123, ["topk"]) == "topk"
+    # junk history entries don't crash the cold start
+    assert (
+        sync_policy.decide(None, 123, [{"form": "xyzzy"}])
+        == sync_policy.COLD_START_FORM
+    )
+
+
+def test_decide_hysteresis_holds_previous_rung():
+    mb = 1_000_000
+    # t = 0.27s: 8% past the 0.25s f32/bf16 boundary — a previous f32
+    # round holds, a cold round steps down to bf16
+    mbps_27 = 8e6 / (0.27 * 1e6)
+    assert sync_policy.decide(mbps_27, mb, ["f32"]) == "f32"
+    assert sync_policy.decide(mbps_27, mb, [{"form": "f32"}]) == "f32"
+    assert sync_policy.decide(mbps_27, mb) == "bf16"
+    # t = 0.22s: within 20% below the boundary — a previous bf16 round
+    # holds, a cold round picks f32
+    mbps_22 = 8e6 / (0.22 * 1e6)
+    assert sync_policy.decide(mbps_22, mb, [{"form": "bf16"}]) == "bf16"
+    assert sync_policy.decide(mbps_22, mb) == "f32"
+    # outside the band the ladder moves regardless of history
+    mbps_50 = 8e6 / (0.50 * 1e6)
+    assert sync_policy.decide(mbps_50, mb, [{"form": "f32"}]) == "bf16"
+
+
+def test_decide_non_adjacent_jump_skips_hysteresis():
+    """Weather collapsing several-fold jumps rungs directly — the band
+    only damps single-rung flapping."""
+    mb = 1_000_000
+    mbps_2s = 8e6 / (2.0 * 1e6)  # int8 band
+    assert sync_policy.decide(mbps_2s, mb, [{"form": "f32"}]) == "int8"
+
+
+def test_projected_push_seconds_validates():
+    assert sync_policy.projected_push_seconds(8.0, 1_000_000) == 1.0
+    with pytest.raises(ValueError, match="link_mbps"):
+        sync_policy.projected_push_seconds(0.0, 100)
+
+
+# -- LinkWeather: the passive estimate ---------------------------------------
+
+
+def test_link_weather_median_and_discards():
+    w = LinkWeather(window=4)
+    assert w.mbps() is None  # cold start
+    w.observe(0, 1.0)  # zero bytes: dispatch, not link
+    w.observe(1000, 1e-4)  # sub-ms: dispatch, not link
+    assert w.mbps() is None and w.observations == 0
+    # 1 MB in 1s = 8 Mbps; one stalled push (0.8 Mbps) doesn't drag
+    # the median
+    for _ in range(3):
+        w.observe(1_000_000, 1.0)
+    w.observe(100_000, 1.0)
+    assert w.observations == 4
+    assert w.mbps() == pytest.approx(8.0)
+    assert len(w.history()) == 4
+    # ring: window=4 keeps only the most recent samples
+    for _ in range(4):
+        w.observe(500_000, 1.0)
+    assert w.mbps() == pytest.approx(4.0)
+
+
+# -- knob parsing / env fallbacks --------------------------------------------
+
+
+def test_sync_knob_env_fallbacks_and_validation(monkeypatch):
+    monkeypatch.setenv(ENV_SYNC_LOCAL_STEPS, "3")
+    monkeypatch.setenv(ENV_SYNC_ADAPTIVE, "on")
+    monkeypatch.setenv(ENV_SYNC_BUCKET_BYTES, "4096")
+    w = _dummy_worker()
+    assert w._sync_local_steps == 3
+    assert w._sync_adaptive is True
+    assert w._sync_bucket_bytes == 4096
+    monkeypatch.delenv(ENV_SYNC_LOCAL_STEPS)
+    monkeypatch.delenv(ENV_SYNC_ADAPTIVE)
+    monkeypatch.delenv(ENV_SYNC_BUCKET_BYTES)
+    w = _dummy_worker()
+    assert w._sync_local_steps == 1
+    assert w._sync_adaptive is False
+    assert w._sync_bucket_bytes == 0
+    with pytest.raises(ValueError, match="sync_local_steps"):
+        _dummy_worker(sync_local_steps=0)
+    with pytest.raises(ValueError, match="sync_adaptive"):
+        _dummy_worker(sync_adaptive="sometimes")
+    with pytest.raises(ValueError, match="sync_bucket_bytes"):
+        _dummy_worker(sync_bucket_bytes=-1)
+
+
+def test_adaptive_counts_as_lossy_and_supersedes_transport_cast():
+    """Adaptive rounds may quantize, so the worker must keep the f32
+    delta as the EF residual source — the bf16 transport cast would
+    double-compress, exactly like a fixed lossy sync_dtype."""
+    w = _dummy_worker(sync_adaptive="on", transport_dtype="bfloat16")
+    assert w._lossy_sync()
+    assert w._transport_dtype == "float32"
+
+
+# -- bucket bounds: layer-aligned greedy packing -----------------------------
+
+
+def test_bucket_bounds_layer_aligned_cover():
+    w = _dummy_worker(sync_bucket_bytes=256 * 4)  # budget: 256 elems
+    w._template = {
+        "a": np.zeros(300, np.float32),  # oversized: split at 256
+        "b": np.zeros(200, np.float32),
+        "c": np.zeros(24, np.float32),
+    }
+    bounds = w._bucket_bounds_for(524)
+    assert bounds[0] == 0 and bounds[-1] == 524
+    assert all(b > a for a, b in zip(bounds, bounds[1:]))
+    # the oversized leaf is cut at the budget; the small leaves are
+    # NEVER split — 500 is the b/c layer boundary (300+200), not a
+    # mid-leaf cut at 512
+    assert bounds == [0, 256, 500, 524]
+    # cached until the flat size changes
+    assert w._bucket_bounds_for(524) is bounds
+    # no template (pre-init): fixed-size cuts still cover exactly
+    w._template = None
+    w._bucket_bounds = None
+    bounds = w._bucket_bounds_for(1000)
+    assert bounds[0] == 0 and bounds[-1] == 1000
+    assert all(b - a <= 256 for a, b in zip(bounds, bounds[1:]))
+
+
+# -- bucket slicing: bit-identical reassembly in every wire form -------------
+
+
+def _wire_form_deltas(n, rng):
+    dense = (rng.standard_normal(n) * 1e-2).astype(np.float32)
+    idx = np.sort(rng.choice(n, size=n // 3, replace=False))
+    vals = dense[idx]
+    return {
+        "f32": dense,
+        "bf16": dense.astype(codec.dtype_from_str("bfloat16")),
+        "int8": codec.quantize_int8(dense, chunk=7),
+        "topk": codec.SparseDelta(indices=idx, values=vals, n=n),
+        "topk_int8": codec.SparseDelta(
+            indices=idx,
+            values=codec.quantize_int8(vals, chunk=5),
+            n=n,
+        ),
+    }
+
+
+@pytest.mark.parametrize(
+    "form", ["f32", "bf16", "int8", "topk", "topk_int8"]
+)
+def test_adjacent_bucket_slices_reassemble_bit_identically(form):
+    """The bucketed push's correctness floor: cutting a delta of ANY
+    wire form at arbitrary bounds and decoding the pieces must equal
+    decoding the whole — int8 scales stay in absolute chunk
+    coordinates through the slice, so dequantization cannot shift."""
+    rng = np.random.default_rng(3)
+    n = 101
+    delta = _wire_form_deltas(n, rng)[form]
+    whole = codec.delta_to_f32(delta)
+    bounds = [0, 13, 14, 52, 96, 101]  # deliberately chunk-misaligned
+    pieces = [
+        codec.delta_to_f32(codec.slice_delta(delta, a, b))
+        for a, b in zip(bounds, bounds[1:])
+    ]
+    np.testing.assert_array_equal(np.concatenate(pieces), whole)
+    assert sum(p.size for p in pieces) == n
+
+
+# -- shard parking: park, atomic apply, dedup --------------------------------
+
+
+def test_shard_parks_partial_set_and_applies_atomically():
+    shard = PSShardServicer(0, 1)
+    shard.init_slice({"vec": np.zeros(8, np.float32), "version": 0})
+    d = np.arange(8, dtype=np.float32)
+    common = {"steps": 2, "base_version": 0, "report_key": "w0"}
+    r = shard.push_delta_bucket(
+        {"delta": d[:5], "offset": 0, "bucket_index": 0,
+         "num_buckets": 2, **common}
+    )
+    # partial set: parked, nothing applied, version unmoved
+    assert r == {"version": 0, "parked": 1}
+    assert shard.stats()["parked_bucket_sets"] == 1
+    np.testing.assert_array_equal(shard.pull({})["vec"], np.zeros(8))
+    r = shard.push_delta_bucket(
+        {"delta": d[5:], "offset": 5, "bucket_index": 1,
+         "num_buckets": 2, **common}
+    )
+    # complete set: applied atomically, version advances by steps ONCE
+    assert r["version"] == 2 and "parked" not in r
+    assert shard.stats()["parked_bucket_sets"] == 0
+    np.testing.assert_array_equal(shard.pull({})["vec"], d)
+    # a replayed part of the applied set dedups (same report_key):
+    # version unmoved, the replayer gets the merged slice to rebase on
+    r = shard.push_delta_bucket(
+        {"delta": d[:5], "offset": 0, "bucket_index": 0,
+         "num_buckets": 2, **common}
+    )
+    assert r["duplicate"] and r["version"] == 2
+    np.testing.assert_array_equal(shard.pull({})["vec"], d)
+
+
+def test_shard_bucketed_apply_matches_flat_push_bit_identically():
+    d = np.linspace(-1, 1, 16).astype(np.float32)
+    flat = PSShardServicer(0, 1)
+    flat.init_slice({"vec": np.ones(16, np.float32), "version": 0})
+    flat.push_delta({"delta": d, "steps": 3, "base_version": 0})
+    bucketed = PSShardServicer(0, 1)
+    bucketed.init_slice({"vec": np.ones(16, np.float32), "version": 0})
+    for j, (a, b) in enumerate(zip([0, 5, 11], [5, 11, 16])):
+        bucketed.push_delta_bucket(
+            {"delta": d[a:b], "offset": a, "bucket_index": j,
+             "num_buckets": 3, "steps": 3, "base_version": 0,
+             "report_key": "w0"}
+        )
+    assert flat.pull({})["version"] == bucketed.pull({})["version"] == 3
+    np.testing.assert_array_equal(
+        flat.pull({})["vec"], bucketed.pull({})["vec"]
+    )
+
+
+def test_shard_re_sent_parked_part_overwrites_idempotently():
+    shard = PSShardServicer(0, 1)
+    shard.init_slice({"vec": np.zeros(4, np.float32), "version": 0})
+    common = {"steps": 1, "base_version": 0, "report_key": "w1",
+              "num_buckets": 2}
+    shard.push_delta_bucket(
+        {"delta": np.full(2, 9.0, np.float32), "offset": 0,
+         "bucket_index": 0, **common}
+    )
+    # the retry re-sends bucket 0 with the REAL payload: slot
+    # overwritten, not double-counted
+    shard.push_delta_bucket(
+        {"delta": np.ones(2, np.float32), "offset": 0,
+         "bucket_index": 0, **common}
+    )
+    r = shard.push_delta_bucket(
+        {"delta": np.ones(2, np.float32), "offset": 2,
+         "bucket_index": 1, **common}
+    )
+    assert r["version"] == 1
+    np.testing.assert_array_equal(shard.pull({})["vec"], np.ones(4))
+
+
+# -- end-to-end: ladder re-bracketing and bucketed jobs ----------------------
+
+
+def _run_window_job(tmp_path, tag, ps_group=None, local_updates=4,
+                    epochs=4, **worker_kwargs):
+    path = str(tmp_path / f"{tag}.rio")
+    write_linear_records(path, 64, noise=0.05)
+    dispatcher = TaskDispatcher(
+        {path: 64}, {}, {}, 16, epochs, shuffle_seed=7
+    )
+    spec = spec_from_module(linear_module)
+    servicer, _evs, _ckpt = build_job(spec, dispatcher, grads_to_wait=1)
+    if ps_group is not None:
+        servicer._ps_group = servicer.ps_group = ps_group
+    worker = Worker(
+        0,
+        InProcessMaster(servicer),
+        spec,
+        minibatch_size=16,
+        local_updates=local_updates,
+        ps_endpoints=ps_group.endpoints if ps_group else None,
+        **worker_kwargs,
+    )
+    assert worker.run()
+    worker.close()
+    assert dispatcher.finished()
+    params, _aux, version = servicer.get_params_copy()
+    return codec.ravel_np(params), version, worker
+
+
+def test_local_steps_defaults_bit_identical_to_knobless_run(tmp_path):
+    """The acceptance bar: --sync_local_steps 1 --sync_adaptive off is
+    today's chain to the last bit (same versions, same trajectory)."""
+    ref, ref_v, _ = _run_window_job(tmp_path, "knobless")
+    vec, v, _ = _run_window_job(
+        tmp_path, "explicit", sync_local_steps=1, sync_adaptive="off"
+    )
+    assert v == ref_v
+    np.testing.assert_array_equal(vec, ref)
+
+
+def test_local_steps_ladder_rebrackets_exactly(tmp_path):
+    """k=2 x W=2 pushes the SAME cumulative deltas at the SAME step
+    boundaries as k=1 x W=4 — the ladder is pure re-bracketing, so the
+    f32 trajectory and version lineage match bit-for-bit."""
+    ref, ref_v, _ = _run_window_job(
+        tmp_path, "w4", local_updates=4, sync_local_steps=1
+    )
+    vec, v, _ = _run_window_job(
+        tmp_path, "w2k2", local_updates=2, sync_local_steps=2
+    )
+    assert v == ref_v
+    np.testing.assert_array_equal(vec, ref)
+
+
+def test_local_steps_exactness_version_accounting(tmp_path):
+    """version == init + applied update steps whatever k is: the
+    super-window report carries steps=k*W and the PS advances by
+    exactly that."""
+    _, v, _worker = _run_window_job(
+        tmp_path, "k4", local_updates=2, sync_local_steps=4, epochs=2
+    )
+    # 64 records x 2 epochs / mb 16 = 8 update steps total
+    assert v == 8
+
+
+def test_adaptive_cold_start_decisions_and_convergence(tmp_path):
+    """In-process pushes are sub-ms, so the passive tracker never gets
+    a valid sample and every round rides the cold-start rung: the
+    decision log must say so honestly (form=bf16, link_mbps=None) and
+    the EF plane keeps the trajectory near f32."""
+    ref, ref_v, _ = _run_window_job(tmp_path, "f32ref")
+    vec, v, worker = _run_window_job(
+        tmp_path, "adaptive", sync_adaptive="on"
+    )
+    assert v == ref_v
+    decisions = worker.sync_decisions
+    assert decisions, "adaptive run recorded no decisions"
+    assert [d["round"] for d in decisions] == list(range(len(decisions)))
+    for d in decisions:
+        assert d["form"] == sync_policy.COLD_START_FORM
+        assert d["link_mbps"] is None
+        assert d["delta_bytes"] > 0 and d["steps"] > 0
+    # bf16 EF band (same bar as the fixed-bf16 convergence test)
+    np.testing.assert_allclose(vec, ref, rtol=2e-2, atol=2e-2)
+    # adaptive off: the log stays empty (no silent half-capture)
+    _, _, off_worker = _run_window_job(
+        tmp_path, "off", sync_adaptive="off"
+    )
+    assert off_worker.sync_decisions == []
+
+
+def test_bucketed_sharded_job_matches_flat_bit_identically(tmp_path):
+    """The full pipeline: worker cuts at layer-aligned bounds, shards
+    park and apply atomically — the final model must equal the flat
+    sharded push to the last bit, with the same version lineage."""
+    group = PSShardGroup(
+        3, mode="inproc", optimizer_factory=linear_module.optimizer
+    )
+    group.start()
+    try:
+        ref, ref_v, _ = _run_window_job(tmp_path, "flat", ps_group=group)
+    finally:
+        group.stop()
+    group = PSShardGroup(
+        3, mode="inproc", optimizer_factory=linear_module.optimizer
+    )
+    group.start()
+    try:
+        # budget of ONE f32 element: every parameter its own bucket —
+        # the maximally-adversarial streaming shape
+        vec, v, _ = _run_window_job(
+            tmp_path, "bucketed", ps_group=group, sync_bucket_bytes=4
+        )
+        versions, _ = group.assemble()
+        assert min(versions) == max(versions) == v
+    finally:
+        group.stop()
+    assert v == ref_v
+    np.testing.assert_array_equal(vec, ref)

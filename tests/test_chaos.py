@@ -227,6 +227,88 @@ def test_drop_applies_server_side_then_retry_dedupes():
         server.stop()
 
 
+@pytest.mark.chaos
+def test_bucketed_super_window_replay_absorbed_by_dedup():
+    """Drop-retry parity for the bucketed push: buckets of one
+    super-window share ONE lineage key (report_key), so every replay
+    shape must land on exact fault-free versions:
+
+    (a) a PARKED part's response is lost — the retry overwrites its
+        slot idempotently and the stream completes (no dedup hit: the
+        set had not applied);
+    (b) the COMPLETING part's response is lost — the set applied, so
+        the retried part (a PARTIAL re-send of the set) must hit the
+        report_key dedup ring, not re-apply;
+    (c) the whole super-window replays under the same key (the
+        spawn-retry shape) — every part dedups, versions do not move,
+        and no ghost parked set is left behind."""
+    from elasticdl_tpu.master.ps_group import PSShardGroup
+    from elasticdl_tpu.rpc.ps_client import ShardedPS
+
+    bounds = [0, 2, 5, 10]  # layer-aligned cuts crossing shard bounds
+
+    def blip_shard_1(ps, group, nth):
+        ps._clients[1].close()
+        ps._clients[1] = RpcClient(
+            group.endpoints[1],
+            policy=fast_policy(),
+            fault_plan=FaultPlan.from_spec(
+                {"faults": [{"kind": "drop",
+                             "methods": ["PSPushDeltaBucket"],
+                             "nth": nth}]}
+            ),
+        )
+
+    group = PSShardGroup(3, mode="inproc")
+    group.start()
+    try:
+        group.ensure_init(np.zeros(10, np.float32), version=0)
+        ps = ShardedPS(group.endpoints, 10)
+
+        # (a) shard 1's FIRST part applies (parks) but the response is
+        # lost: the retry re-parks idempotently, the stream completes
+        blip_shard_1(ps, group, 1)
+        versions, _ = ps.push_delta_bucketed(
+            np.ones(10, np.float32), 2, [0, 0, 0], bounds,
+            report_key="sw0",
+        )
+        assert versions == [2, 2, 2], f"torn after parked drop: {versions}"
+        _, vec = ps.pull()
+        np.testing.assert_allclose(vec, 1.0)
+        assert group.servicers[1].stats()["duplicate_pushes"] == 0
+
+        # (b) shard 1's LAST part completes the set, response lost: the
+        # retry must dedup on the shared lineage key, not double-apply
+        blip_shard_1(ps, group, 2)
+        versions, _ = ps.push_delta_bucketed(
+            np.ones(10, np.float32), 2, [2, 2, 2], bounds,
+            report_key="sw1",
+        )
+        assert versions == [4, 4, 4], f"torn after apply drop: {versions}"
+        _, vec = ps.pull()
+        np.testing.assert_allclose(vec, 2.0)  # applied exactly once
+        assert group.servicers[1].stats()["duplicate_pushes"] >= 1
+
+        # (c) full replay under the same lineage key with a PARTIAL
+        # part set re-sent: every part dedups, versions stay exact
+        before = [sv.stats()["duplicate_pushes"] for sv in group.servicers]
+        versions, _ = ps.push_delta_bucketed(
+            np.ones(10, np.float32), 2, [2, 2, 2], bounds,
+            report_key="sw1",
+        )
+        assert versions == [4, 4, 4], f"replay moved versions: {versions}"
+        _, vec = ps.pull()
+        np.testing.assert_allclose(vec, 2.0)
+        after = [sv.stats()["duplicate_pushes"] for sv in group.servicers]
+        assert all(b > a for a, b in zip(before, after))
+        assert all(
+            sv.stats()["parked_bucket_sets"] == 0 for sv in group.servicers
+        ), "replayed parts must not park a ghost set"
+        ps.close()
+    finally:
+        group.stop()
+
+
 def test_server_side_error_injection_retried():
     hits = []
     plan = FaultPlan.from_spec(
